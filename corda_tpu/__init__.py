@@ -1,0 +1,14 @@
+"""corda_tpu — a TPU-native distributed-ledger framework.
+
+Capabilities of Corda (reference survey: SURVEY.md), architecture of JAX/XLA:
+
+- ``corda_tpu.core``     — ledger algebra, crypto, transactions, serialization, flows API
+- ``corda_tpu.ops``      — JAX/Pallas device kernels (SHA-256, Ed25519, secp256k1, Merkle)
+- ``corda_tpu.parallel`` — device-mesh sharding and multi-chip fan-out
+- ``corda_tpu.node``     — node runtime (state machine, messaging, services, notaries)
+- ``corda_tpu.models``   — contract/flow "model families" (finance CorDapps, demos)
+- ``corda_tpu.verifier`` — standalone verification worker
+- ``corda_tpu.testing``  — MockNetwork, ledger DSL, driver
+"""
+
+__version__ = "0.1.0"

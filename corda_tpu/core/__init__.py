@@ -1,0 +1,5 @@
+"""Core ledger algebra: the layer every other component builds on.
+
+Mirrors the role of the reference's ``core/`` module (SURVEY.md §2.1): depends on
+nothing framework-internal; everything depends on it.
+"""

@@ -1,0 +1,38 @@
+"""Host-side cryptography: hashing, signature schemes, composite keys, Merkle trees.
+
+The device (TPU) implementations of the hot paths live in ``corda_tpu.ops``; this
+package is the authoritative host semantics they are tested bit-exact against.
+
+Reference parity: core/src/main/kotlin/net/corda/core/crypto (Crypto.kt, SecureHash.kt,
+MerkleTree.kt, PartialMerkleTree.kt, composite/CompositeKey.kt).
+"""
+from .secure_hash import SecureHash, sha256, sha256_twice, hash_concat
+from .schemes import (
+    SignatureScheme,
+    EDDSA_ED25519_SHA512,
+    ECDSA_SECP256K1_SHA256,
+    ECDSA_SECP256R1_SHA256,
+    RSA_SHA256,
+    SPHINCS256_SHA256,
+    COMPOSITE_KEY,
+    ALL_SCHEMES,
+    DEFAULT_SIGNATURE_SCHEME,
+    scheme_by_id,
+)
+from .keys import PublicKey, PrivateKey, KeyPair, generate_keypair
+from .signatures import DigitalSignature, TransactionSignature, Crypto
+from .composite import CompositeKey, CompositeSignature, CompositeSignaturesWithKeys
+from .merkle import MerkleTree, PartialMerkleTree, MerkleTreeException
+from .base58 import b58encode, b58decode
+
+__all__ = [
+    "SecureHash", "sha256", "sha256_twice", "hash_concat",
+    "SignatureScheme", "EDDSA_ED25519_SHA512", "ECDSA_SECP256K1_SHA256",
+    "ECDSA_SECP256R1_SHA256", "RSA_SHA256", "SPHINCS256_SHA256", "COMPOSITE_KEY",
+    "ALL_SCHEMES", "DEFAULT_SIGNATURE_SCHEME", "scheme_by_id",
+    "PublicKey", "PrivateKey", "KeyPair", "generate_keypair",
+    "DigitalSignature", "TransactionSignature", "Crypto",
+    "CompositeKey", "CompositeSignature", "CompositeSignaturesWithKeys",
+    "MerkleTree", "PartialMerkleTree", "MerkleTreeException",
+    "b58encode", "b58decode",
+]

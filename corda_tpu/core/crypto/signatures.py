@@ -1,0 +1,139 @@
+"""Signing and verification dispatch across schemes — the ``Crypto`` facade.
+
+Reference parity: Crypto.kt doSign (:368-432), doVerify (:438-511), isValid (:518-544);
+DigitalSignature.WithKey (DigitalSignature.kt:25); CryptoUtils.kt:49.
+
+The hot path in production is NOT this module: batched verification runs on TPU via
+``corda_tpu.ops`` / the verifier service. This host path is the semantic oracle, the
+signing path, and the fallback for schemes with no device kernel (RSA).
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from . import ecmath
+from .keys import PublicKey, PrivateKey, KeyPair, curve_for_scheme, sec1_decompress
+from .schemes import (
+    SignatureScheme, RSA_SHA256, ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256,
+    EDDSA_ED25519_SHA512, SPHINCS256_SHA256,
+)
+
+
+class SignatureException(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class DigitalSignature:
+    """A raw signature (scheme-specific encoding: Ed25519 = 64-byte RFC 8032;
+    ECDSA = DER (r,s); RSA = PKCS#1 block)."""
+
+    bytes: bytes
+
+    def __hash__(self):
+        return hash(self.bytes)
+
+
+@dataclass(frozen=True)
+class DigitalSignatureWithKey(DigitalSignature):
+    """A signature bundled with the verification key (DigitalSignature.WithKey)."""
+
+    by: PublicKey
+
+    def verify(self, content: bytes) -> bool:
+        """Raise on invalid signature; return True on success (doVerify semantics)."""
+        return Crypto.do_verify(self.by, self.bytes, content)
+
+    def is_valid(self, content: bytes) -> bool:
+        """Non-throwing validity check (isValid semantics)."""
+        return Crypto.is_valid(self.by, self.bytes, content)
+
+    def without_key(self) -> DigitalSignature:
+        return DigitalSignature(self.bytes)
+
+    def __hash__(self):
+        return hash((self.bytes, self.by))
+
+
+# Alias matching the transaction-layer naming.
+TransactionSignature = DigitalSignatureWithKey
+
+
+class Crypto:
+    """Scheme dispatch (mirror of the reference ``Crypto`` object)."""
+
+    @staticmethod
+    def do_sign(private: PrivateKey, content: bytes,
+                public: PublicKey | None = None) -> bytes:
+        sid = private.scheme.scheme_number_id
+        if sid == EDDSA_ED25519_SHA512.scheme_number_id:
+            pub_bytes = public.encoded if public is not None else None
+            return ecmath.ed25519_sign(private.encoded, content, public=pub_bytes)
+        if sid in (ECDSA_SECP256K1_SHA256.scheme_number_id,
+                   ECDSA_SECP256R1_SHA256.scheme_number_id):
+            curve = curve_for_scheme(private.scheme)
+            d = int.from_bytes(private.encoded, "big")
+            r, s = ecmath.ecdsa_sign(curve, d, content)
+            return ecmath.ecdsa_sig_to_der(r, s)
+        if sid == RSA_SHA256.scheme_number_id:
+            from cryptography.hazmat.primitives.asymmetric import padding
+            from cryptography.hazmat.primitives import hashes, serialization
+            key = serialization.load_der_private_key(private.encoded, password=None)
+            return key.sign(content, padding.PKCS1v15(), hashes.SHA256())
+        if sid == SPHINCS256_SHA256.scheme_number_id:
+            raise SignatureException(
+                "SPHINCS-256 signing is not yet implemented in corda_tpu")
+        raise SignatureException(f"Unsupported scheme for signing: {private.scheme}")
+
+    @staticmethod
+    def sign_with_key(keypair_or_private, content: bytes, public: PublicKey | None = None
+                      ) -> DigitalSignatureWithKey:
+        if isinstance(keypair_or_private, KeyPair):
+            private, public = keypair_or_private.private, keypair_or_private.public
+        else:
+            private = keypair_or_private
+            if public is None:
+                raise ValueError("public key required when signing with a bare private key")
+        return DigitalSignatureWithKey(Crypto.do_sign(private, content, public), public)
+
+    @staticmethod
+    def is_valid(public: PublicKey, signature: bytes, content: bytes) -> bool:
+        sid = public.scheme.scheme_number_id
+        if sid == EDDSA_ED25519_SHA512.scheme_number_id:
+            return ecmath.ed25519_verify(public.encoded, content, signature)
+        if sid in (ECDSA_SECP256K1_SHA256.scheme_number_id,
+                   ECDSA_SECP256R1_SHA256.scheme_number_id):
+            curve = curve_for_scheme(public.scheme)
+            point = sec1_decompress(curve, public.encoded)
+            if point is None:
+                return False
+            try:
+                r, s = ecmath.ecdsa_sig_from_der(signature)
+            except (ValueError, IndexError):
+                return False
+            return ecmath.ecdsa_verify(curve, point, content, r, s)
+        if sid == RSA_SHA256.scheme_number_id:
+            from cryptography.hazmat.primitives.asymmetric import padding
+            from cryptography.hazmat.primitives import hashes, serialization
+            from cryptography.exceptions import InvalidSignature
+            key = serialization.load_der_public_key(public.encoded)
+            try:
+                key.verify(signature, content, padding.PKCS1v15(), hashes.SHA256())
+                return True
+            except InvalidSignature:
+                return False
+        raise SignatureException(f"Unsupported scheme for verification: {public.scheme}")
+
+    @staticmethod
+    def do_verify(public: PublicKey, signature: bytes, content: bytes) -> bool:
+        if not content:
+            raise SignatureException("Signing of an empty array is not permitted")
+        if not Crypto.is_valid(public, signature, content):
+            raise SignatureException(
+                f"Signature by {public.to_string_short()} did not verify")
+        return True
+
+
+def sha256_digest(content: bytes) -> bytes:
+    return hashlib.sha256(content).digest()

@@ -1,0 +1,12 @@
+"""Test configuration: force a virtual 8-device CPU mesh before JAX initialises.
+
+Mirrors the reference's deterministic in-process multi-node testing strategy
+(MockNetwork, reference test-utils/.../node/MockNode.kt:41-66): we test multi-chip
+sharding without real chips by asking XLA for 8 host-platform devices.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
