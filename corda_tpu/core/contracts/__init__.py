@@ -1,0 +1,34 @@
+"""The ledger algebra: states, commands, amounts, transaction-verification rules.
+
+Reference parity: core/.../contracts/ (Structures.kt, Amount.kt,
+TransactionVerification.kt, TransactionTypes.kt, clauses/).
+"""
+from .structures import (
+    Contract, ContractState, OwnableState, FungibleAsset, LinearState, SchedulableState,
+    ScheduledActivity, TransactionState, StateRef, StateAndRef, Command,
+    AuthenticatedObject, CommandData, TypeOnlyCommandData, MoveCommand, IssueCommand,
+    ExitCommand, TimeWindow, PartyAndReference, Issued, UniqueIdentifier, Attachment,
+    requireThat,
+)
+from .amount import Amount, Currency, USD, GBP, EUR, CHF
+from .exceptions import (
+    TransactionVerificationException, TransactionResolutionException,
+    AttachmentResolutionException, ContractRejection, MoreThanOneNotary,
+    SignersMissing, DuplicateInputStates, InvalidNotaryChange,
+    NotaryChangeInWrongTransactionType, TransactionMissingEncumbranceException,
+)
+from .transaction_types import TransactionType
+
+__all__ = [
+    "Contract", "ContractState", "OwnableState", "FungibleAsset", "LinearState",
+    "SchedulableState", "ScheduledActivity", "TransactionState", "StateRef",
+    "StateAndRef", "Command", "AuthenticatedObject", "CommandData",
+    "TypeOnlyCommandData", "MoveCommand", "IssueCommand", "ExitCommand", "TimeWindow",
+    "PartyAndReference", "Issued", "UniqueIdentifier", "Attachment", "requireThat",
+    "Amount", "Currency", "USD", "GBP", "EUR", "CHF",
+    "TransactionVerificationException", "TransactionResolutionException",
+    "AttachmentResolutionException", "ContractRejection", "MoreThanOneNotary",
+    "SignersMissing", "DuplicateInputStates", "InvalidNotaryChange",
+    "NotaryChangeInWrongTransactionType", "TransactionMissingEncumbranceException",
+    "TransactionType",
+]

@@ -297,7 +297,9 @@ def ecdsa_sign(curve: WeierstrassCurve, priv: int, msg: bytes) -> tuple[int, int
 
 
 def ecdsa_verify(curve: WeierstrassCurve, pub, msg: bytes, r: int, s: int) -> bool:
-    if not (1 <= r < curve.n and 1 <= s < curve.n):
+    # Low-s only (matching the signer's normalisation): rejects the s' = n - s
+    # malleated twin so each message/key pair has exactly one accepted signature.
+    if not (1 <= r < curve.n and 1 <= s <= curve.n // 2):
         return False
     if pub is None or not curve.is_on_curve(pub):
         return False

@@ -1,0 +1,231 @@
+"""The canonical binary codec.
+
+Wire model: every value is transformed into a *wire tree* of msgpack-safe primitives
+(None, bool, int64, bytes, str, list) plus tagged ExtType wrappers for everything
+else, then packed with msgpack (C implementation) in one pass:
+
+- ``ExtType(1, …)``  OBJ     — registered type: packb([type_name, [field wires…]])
+- ``ExtType(2, …)``  MAP     — dict: packb([[k, v]…]) sorted by packed key bytes
+- ``ExtType(3, …)``  SET     — set/frozenset: packb([…]) sorted by packed bytes
+- ``ExtType(4, …)``  BIGINT  — arbitrary-precision int: sign byte + magnitude
+- ``ExtType(5, …)``  ENUM    — packb([enum_type_name, member_name])
+
+Registered types declare their wire fields; deserialization only ever constructs
+registered types (whitelist enforcement).
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import enum
+from typing import Any, Callable
+
+import msgpack
+
+from ..crypto.secure_hash import SecureHash
+
+FORMAT_VERSION = 1
+_MAGIC = b"\xc0\x9d\xa1" + bytes([FORMAT_VERSION])  # leads every top-level message
+
+_EXT_OBJ = 1
+_EXT_MAP = 2
+_EXT_SET = 3
+_EXT_BIGINT = 4
+_EXT_ENUM = 5
+_EXT_INSTANT = 6  # UTC datetime as epoch-microseconds (big-endian i64)
+
+_I64_MIN, _I64_MAX = -(2**63), 2**63 - 1
+
+
+class SerializationError(Exception):
+    pass
+
+
+_EPOCH = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+
+
+def exact_epoch_micros(t: datetime.datetime) -> int:
+    """Exact integer epoch-microseconds (no float path — ``timestamp()`` truncation
+    corrupts ~1% of microsecond values, which would fork consensus hashes)."""
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=datetime.timezone.utc)
+    return (t - _EPOCH) // datetime.timedelta(microseconds=1)
+
+
+# ---------------------------------------------------------------------------
+# Type registry (the whitelist)
+# ---------------------------------------------------------------------------
+
+# name -> (cls, to_fields, from_fields)
+_REGISTRY: dict[str, tuple[type, Callable, Callable]] = {}
+_BY_CLASS: dict[type, str] = {}
+_ENUM_REGISTRY: dict[str, type] = {}
+
+
+def register_type(name: str, cls: type,
+                  to_fields: Callable[[Any], list] | None = None,
+                  from_fields: Callable[[list], Any] | None = None) -> None:
+    """Register a type for serialization. Defaults handle dataclasses (fields in
+    declaration order — deterministic)."""
+    if name in _REGISTRY and _REGISTRY[name][0] is not cls:
+        raise SerializationError(f"Serialization name collision: {name!r}")
+    if to_fields is None or from_fields is None:
+        if not dataclasses.is_dataclass(cls):
+            raise SerializationError(
+                f"{cls!r} is not a dataclass; provide to_fields/from_fields")
+        field_names = [f.name for f in dataclasses.fields(cls)]
+        to_fields = to_fields or (lambda obj, _fn=field_names:
+                                  [getattr(obj, n) for n in _fn])
+        # Sequences decode as lists; dataclass wire types are immutable, so coerce
+        # top-level list fields back to tuples for equality/hashability.
+        from_fields = from_fields or (
+            lambda fields, _c=cls: _c(*[tuple(f) if isinstance(f, list) else f
+                                        for f in fields]))
+    _REGISTRY[name] = (cls, to_fields, from_fields)
+    _BY_CLASS[cls] = name
+
+
+def serializable(name: str | None = None,
+                 to_fields: Callable | None = None,
+                 from_fields: Callable | None = None):
+    """Class decorator: ``@serializable()`` registers the class under its qualname."""
+    def wrap(cls):
+        reg_name = name or cls.__name__
+        if issubclass(cls, enum.Enum):
+            _ENUM_REGISTRY[reg_name] = cls
+            cls.__corda_enum_name__ = reg_name
+        else:
+            register_type(reg_name, cls, to_fields, from_fields)
+        return cls
+    return wrap
+
+
+def registered_name(cls: type) -> str | None:
+    return _BY_CLASS.get(cls)
+
+
+# ---------------------------------------------------------------------------
+# Wire-tree transform
+# ---------------------------------------------------------------------------
+
+def _packb(wire) -> bytes:
+    return msgpack.packb(wire, use_bin_type=True, strict_types=True)
+
+
+def to_wire(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (bool, str)):
+        return obj
+    if isinstance(obj, int) and not isinstance(obj, bool):
+        if _I64_MIN <= obj <= _I64_MAX:
+            return obj
+        sign = 1 if obj >= 0 else 0
+        mag = abs(obj)
+        return msgpack.ExtType(_EXT_BIGINT, bytes([sign]) +
+                               mag.to_bytes((mag.bit_length() + 7) // 8 or 1, "big"))
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return bytes(obj)
+    if isinstance(obj, float):
+        raise SerializationError(
+            "Floats are not permitted in consensus data (non-deterministic); "
+            "use integer quantities (Amount semantics)")
+    if isinstance(obj, (list, tuple)):
+        return [to_wire(x) for x in obj]
+    if isinstance(obj, dict):
+        pairs = sorted(([_packb(to_wire(k)), to_wire(v)] for k, v in obj.items()),
+                       key=lambda kv: kv[0])
+        return msgpack.ExtType(_EXT_MAP, _packb(pairs))
+    if isinstance(obj, (set, frozenset)):
+        elems = sorted(_packb(to_wire(x)) for x in obj)
+        return msgpack.ExtType(_EXT_SET, _packb(elems))
+    if isinstance(obj, datetime.datetime):
+        return msgpack.ExtType(_EXT_INSTANT,
+                               exact_epoch_micros(obj).to_bytes(8, "big", signed=True))
+    if isinstance(obj, enum.Enum):
+        ename = getattr(type(obj), "__corda_enum_name__", None)
+        if ename is None:
+            raise SerializationError(f"Enum {type(obj)!r} is not @serializable")
+        return msgpack.ExtType(_EXT_ENUM, _packb([ename, obj.name]))
+    name = _BY_CLASS.get(type(obj))
+    if name is None:
+        raise SerializationError(
+            f"Type {type(obj).__module__}.{type(obj).__qualname__} is not registered "
+            f"for serialization (whitelist violation)")
+    _, to_fields, _ = _REGISTRY[name]
+    fields = [to_wire(f) for f in to_fields(obj)]
+    return msgpack.ExtType(_EXT_OBJ, _packb([name, fields]))
+
+
+def _unpackb(data: bytes):
+    return msgpack.unpackb(data, raw=False, strict_map_key=False,
+                           ext_hook=lambda c, d: msgpack.ExtType(c, d))
+
+
+def from_wire(wire: Any) -> Any:
+    if wire is None or isinstance(wire, (bool, int, str, bytes)):
+        return wire
+    # NB: ExtType subclasses tuple, so it must be checked before the sequence case.
+    if isinstance(wire, msgpack.ExtType):
+        code, data = wire.code, wire.data
+        if code == _EXT_BIGINT:
+            if len(data) < 2:
+                raise SerializationError("Truncated bigint")
+            val = int.from_bytes(data[1:], "big")
+            return val if data[0] else -val
+        if code == _EXT_MAP:
+            return {_freeze(from_wire(_unpackb(k))): from_wire(v)
+                    for k, v in _unpackb(data)}
+        if code == _EXT_SET:
+            return frozenset(_freeze(from_wire(_unpackb(e))) for e in _unpackb(data))
+        if code == _EXT_INSTANT:
+            micros = int.from_bytes(data, "big", signed=True)
+            return datetime.datetime.fromtimestamp(micros / 1_000_000,
+                                                   tz=datetime.timezone.utc)
+        if code == _EXT_ENUM:
+            ename, member = _unpackb(data)
+            cls = _ENUM_REGISTRY.get(ename)
+            if cls is None:
+                raise SerializationError(f"Enum {ename!r} is not whitelisted")
+            return cls[member]
+        if code == _EXT_OBJ:
+            name, fields = _unpackb(data)
+            entry = _REGISTRY.get(name)
+            if entry is None:
+                raise SerializationError(f"Type {name!r} is not whitelisted")
+            _, _, from_fields = entry
+            return from_fields([from_wire(f) for f in fields])
+        raise SerializationError(f"Unknown ext code {code}")
+    if isinstance(wire, (list, tuple)):
+        return [from_wire(x) for x in wire]
+    raise SerializationError(f"Unexpected wire value of type {type(wire)!r}")
+
+
+def _freeze(v):
+    return tuple(v) if isinstance(v, list) else v
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+def serialize(obj: Any) -> bytes:
+    return _MAGIC + _packb(to_wire(obj))
+
+
+def deserialize(data: bytes) -> Any:
+    if len(data) < 4 or data[:3] != _MAGIC[:3]:
+        raise SerializationError("Bad magic: not corda_tpu canonical bytes")
+    if data[3] != FORMAT_VERSION:
+        raise SerializationError(f"Unsupported format version {data[3]}")
+    try:
+        return from_wire(_unpackb(data[4:]))
+    except SerializationError:
+        raise
+    except Exception as e:
+        # Untrusted wire bytes must always fail typed, never leak raw decode errors.
+        raise SerializationError(f"Malformed canonical bytes: {type(e).__name__}: {e}") from e
+
+
+def serialized_hash(obj: Any) -> SecureHash:
+    """Merkle component leaf hash: SHA-256 of the canonical bytes (magic included,
+    so leaves are domain-separated from raw user bytes)."""
+    return SecureHash.sha256(serialize(obj))

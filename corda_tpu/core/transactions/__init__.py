@@ -1,0 +1,17 @@
+"""Transaction types: wire format, signed wrapper, resolved (verifiable) form,
+tear-offs and the builder.
+
+Reference parity: core/.../transactions/ (WireTransaction.kt, SignedTransaction.kt,
+LedgerTransaction.kt, MerkleTransaction.kt, TransactionBuilder.kt).
+"""
+from .wire import WireTransaction, TraversableTransaction
+from .signed import SignedTransaction, SignaturesMissingException
+from .ledger import LedgerTransaction, TransactionForContract, InOutGroup
+from .filtered import FilteredLeaves, FilteredTransaction
+from .builder import TransactionBuilder
+
+__all__ = [
+    "WireTransaction", "TraversableTransaction", "SignedTransaction",
+    "SignaturesMissingException", "LedgerTransaction", "TransactionForContract",
+    "InOutGroup", "FilteredLeaves", "FilteredTransaction", "TransactionBuilder",
+]

@@ -1,0 +1,6 @@
+"""Test infrastructure — a first-class layer, as in the reference (SURVEY.md §4):
+dummy contracts, mock services, the in-memory MockNetwork, ledger DSL and driver.
+"""
+from .dummy import DummyContract, DummyState, DUMMY_NOTARY_NAME
+
+__all__ = ["DummyContract", "DummyState", "DUMMY_NOTARY_NAME"]

@@ -1,0 +1,38 @@
+"""Amount arithmetic tests (reference analog: core AmountTests)."""
+import pytest
+
+from corda_tpu.core.contracts import Amount, USD, GBP
+from corda_tpu.core.contracts.amount import sum_or_none, sum_or_throw, sum_or_zero
+
+
+def test_amount_arithmetic():
+    a, b = Amount(100, USD), Amount(250, USD)
+    assert (a + b).quantity == 350
+    assert (b - a).quantity == 150
+    assert (a * 3).quantity == 300
+    assert a < b and b >= a
+    with pytest.raises(ValueError):
+        a + Amount(1, GBP)
+    with pytest.raises(ValueError):
+        Amount(-1, USD)
+    with pytest.raises(ValueError):
+        a - b  # would go negative
+    with pytest.raises(ValueError):
+        a * 1.5  # non-int factor
+
+
+def test_amount_splits_and_sums():
+    a = Amount(10, USD)
+    parts = a.splits(3)
+    assert [p.quantity for p in parts] == [4, 3, 3]
+    assert sum_or_throw(parts) == a
+    assert sum_or_none([]) is None
+    assert sum_or_zero([], USD) == Amount(0, USD)
+    with pytest.raises(ValueError):
+        sum_or_throw([])
+
+
+def test_amount_roundtrip():
+    from corda_tpu.core.serialization import serialize, deserialize
+    a = Amount(12345, USD)
+    assert deserialize(serialize(a)) == a

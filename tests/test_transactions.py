@@ -1,0 +1,193 @@
+"""Transaction layer tests: ids, signatures, platform rules, tear-offs.
+
+(Reference analogs: WireTransaction/SignedTransaction tests, TransactionTypesTests,
+PartialMerkleTreeTest's FilteredTransaction cases.)
+"""
+import pytest
+
+from corda_tpu.core.contracts import (
+    Command, StateAndRef, StateRef, TimeWindow, TransactionState, TransactionType,
+    DuplicateInputStates, SignersMissing, MoreThanOneNotary, ContractRejection,
+    TransactionVerificationException, InvalidNotaryChange,
+)
+from corda_tpu.core.crypto import generate_keypair, SecureHash
+from corda_tpu.core.identity import Party
+from corda_tpu.core.serialization import serialize, deserialize
+from corda_tpu.core.transactions import (
+    WireTransaction, SignedTransaction, SignaturesMissingException,
+    TransactionBuilder, FilteredTransaction, LedgerTransaction,
+)
+from corda_tpu.testing import DummyContract, DummyState, DUMMY_NOTARY_NAME
+
+NOTARY_KP = generate_keypair(entropy=b"\x10" * 32)
+NOTARY = Party(DUMMY_NOTARY_NAME, NOTARY_KP.public)
+ALICE_KP = generate_keypair(entropy=b"\x11" * 32)
+ALICE = Party("O=Alice Corp, L=Madrid, C=ES", ALICE_KP.public)
+BOB_KP = generate_keypair(entropy=b"\x12" * 32)
+BOB = Party("O=Bob Plc, L=Rome, C=IT", BOB_KP.public)
+
+
+def make_wtx(**kw):
+    defaults = dict(
+        inputs=(), attachments=(),
+        outputs=(TransactionState(DummyState(1, (ALICE_KP.public,)), NOTARY),),
+        commands=(Command(DummyContract.Create(), (ALICE_KP.public,)),),
+        notary=NOTARY, must_sign=(ALICE_KP.public,),
+        type=TransactionType.General, time_window=None)
+    defaults.update(kw)
+    return WireTransaction(**defaults)
+
+
+def ltx_from(wtx, input_states=()):
+    """Resolve without a ServiceHub (direct construction) for rule tests."""
+    from corda_tpu.core.contracts.structures import AuthenticatedObject
+    return LedgerTransaction(
+        inputs=tuple(input_states), outputs=wtx.outputs,
+        commands=tuple(AuthenticatedObject(c.signers, (), c.value) for c in wtx.commands),
+        attachments=(), id=wtx.id, notary=wtx.notary, must_sign=wtx.must_sign,
+        type=wtx.type, time_window=wtx.time_window)
+
+
+def test_wire_transaction_id_is_component_merkle_root():
+    wtx = make_wtx()
+    from corda_tpu.core.crypto.merkle import MerkleTree
+    assert wtx.id == MerkleTree.get_merkle_tree(wtx.available_component_hashes).hash
+    # component order: inputs, attachments, outputs, commands, notary, signers, type
+    comps = wtx.available_components
+    assert comps[0] == wtx.outputs[0]
+    assert comps[1] == wtx.commands[0]
+    assert comps[2] == wtx.notary
+    assert comps[3] == ALICE_KP.public
+    assert comps[4] == TransactionType.General
+    # deterministic across serialization round trip
+    wtx2 = deserialize(serialize(wtx))
+    assert wtx2.id == wtx.id
+    # changing any component changes the id
+    assert make_wtx(must_sign=(BOB_KP.public,)).id != wtx.id
+
+
+def test_signed_transaction_signature_checking():
+    wtx = make_wtx(must_sign=(ALICE_KP.public, BOB_KP.public))
+    alice_sig = __import__("corda_tpu.core.crypto.signatures", fromlist=["Crypto"]) \
+        .Crypto.sign_with_key(ALICE_KP, wtx.id.bytes)
+    stx = SignedTransaction.of(wtx, (alice_sig,))
+    stx.check_signatures_are_valid()
+    with pytest.raises(SignaturesMissingException):
+        stx.verify_signatures()
+    # allowed-to-be-missing lets collection flows proceed
+    assert stx.verify_signatures(BOB_KP.public) == {BOB_KP.public}
+    # adding Bob's signature completes it
+    from corda_tpu.core.crypto.signatures import Crypto
+    stx2 = stx.plus(Crypto.sign_with_key(BOB_KP, wtx.id.bytes))
+    assert stx2.verify_signatures() == set()
+    # a wrong signature fails cryptographically
+    bad = Crypto.sign_with_key(BOB_KP, b"other content")
+    from corda_tpu.core.crypto.signatures import SignatureException
+    with pytest.raises(SignatureException):
+        SignedTransaction.of(wtx, (alice_sig, bad)).check_signatures_are_valid()
+
+
+def test_platform_rule_duplicate_inputs():
+    ref = StateRef(SecureHash.sha256(b"prev"), 0)
+    state = TransactionState(DummyState(1, (ALICE_KP.public,)), NOTARY)
+    wtx = make_wtx(inputs=(ref, ref), must_sign=(ALICE_KP.public, NOTARY_KP.public))
+    ltx = ltx_from(wtx, [StateAndRef(state, ref), StateAndRef(state, ref)])
+    with pytest.raises(DuplicateInputStates):
+        ltx.verify()
+
+
+def test_platform_rule_missing_signers():
+    wtx = make_wtx(commands=(Command(DummyContract.Create(), (BOB_KP.public,)),),
+                   must_sign=(ALICE_KP.public,))
+    with pytest.raises(SignersMissing):
+        ltx_from(wtx).verify()
+
+
+def test_platform_rule_more_than_one_notary():
+    other_notary = Party("O=Other Notary, L=Oslo, C=NO", BOB_KP.public)
+    ref1 = StateRef(SecureHash.sha256(b"a"), 0)
+    ref2 = StateRef(SecureHash.sha256(b"b"), 0)
+    s1 = StateAndRef(TransactionState(DummyState(1), NOTARY), ref1)
+    s2 = StateAndRef(TransactionState(DummyState(2), other_notary), ref2)
+    wtx = make_wtx(inputs=(ref1, ref2),
+                   must_sign=(ALICE_KP.public, NOTARY_KP.public, BOB_KP.public))
+    with pytest.raises(MoreThanOneNotary):
+        ltx_from(wtx, [s1, s2]).verify()
+
+
+def test_platform_rule_time_window_requires_notary():
+    import datetime
+    tw = TimeWindow.from_only(datetime.datetime(2026, 1, 1))
+    wtx = make_wtx(notary=None, time_window=tw,
+                   outputs=(TransactionState(DummyState(1), NOTARY),))
+    with pytest.raises(TransactionVerificationException):
+        ltx_from(wtx).verify()
+
+
+def test_contract_rejection():
+    from corda_tpu.core.serialization import serializable
+
+    class AngryContract(DummyContract):
+        def verify(self, tx):
+            raise ValueError("no thanks")
+
+    @serializable("test.AngryState")
+    class AngryState(DummyState):
+        @property
+        def contract(self):
+            return AngryContract()
+
+    wtx = make_wtx(outputs=(TransactionState(AngryState(1), NOTARY),))
+    with pytest.raises(ContractRejection):
+        ltx_from(wtx).verify()
+
+
+def test_notary_change_rules():
+    other_notary = Party("O=Other Notary, L=Oslo, C=NO",
+                         generate_keypair(entropy=b"\x13" * 32).public)
+    state = DummyState(7, (ALICE_KP.public,))
+    ref = StateRef(SecureHash.sha256(b"x"), 0)
+    inp = StateAndRef(TransactionState(state, NOTARY), ref)
+    good = make_wtx(
+        inputs=(ref,), outputs=(TransactionState(state, other_notary),), commands=(),
+        type=TransactionType.NotaryChange,
+        must_sign=(ALICE_KP.public, NOTARY_KP.public))
+    ltx_from(good, [inp]).verify()
+    # modifying the state data is invalid
+    bad = make_wtx(
+        inputs=(ref,), outputs=(TransactionState(DummyState(8), other_notary),),
+        commands=(), type=TransactionType.NotaryChange,
+        must_sign=(ALICE_KP.public, NOTARY_KP.public))
+    with pytest.raises(InvalidNotaryChange):
+        ltx_from(bad, [inp]).verify()
+
+
+def test_transaction_builder_end_to_end():
+    b = TransactionBuilder(notary=NOTARY)
+    b.add_output_state(DummyState(5, (ALICE_KP.public,)))
+    b.add_command(DummyContract.Create(), ALICE_KP.public)
+    b.sign_with(ALICE_KP)
+    with pytest.raises(ValueError):
+        b.add_command(DummyContract.Move(), BOB_KP.public)  # locked after signing
+    stx = b.to_signed_transaction()
+    assert stx.verify_signatures() == set()
+    assert stx.tx.notary == NOTARY
+
+
+def test_filtered_transaction_tear_off():
+    wtx = make_wtx()
+    # Reveal only commands (the oracle pattern: NodeInterestRates.kt:149-180).
+    ftx = wtx.build_filtered_transaction(lambda c: isinstance(c, Command))
+    assert ftx.verify()
+    assert ftx.filtered_leaves.commands == wtx.commands
+    assert ftx.filtered_leaves.outputs == ()
+    assert ftx.filtered_leaves.check_with_fun(lambda c: isinstance(c, Command))
+    # Round-trips through the codec (notaries sign these remotely).
+    ftx2 = deserialize(serialize(ftx))
+    assert ftx2.verify() and ftx2.root_hash == wtx.id
+    # Tamper: swap in a different command
+    from corda_tpu.core.transactions.filtered import FilteredLeaves
+    forged_leaves = FilteredLeaves(commands=(Command(DummyContract.Move(),
+                                                    (ALICE_KP.public,)),))
+    forged = FilteredTransaction(ftx.root_hash, forged_leaves, ftx.partial_merkle_tree)
+    assert not forged.verify()
