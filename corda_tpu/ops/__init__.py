@@ -1,0 +1,23 @@
+"""Device (TPU) kernels: batched SHA-256, Merkle trees, Ed25519 and secp256k1
+signature verification.
+
+These are the hot inner loops of transaction verification (reference call stack
+SURVEY.md §3.3: Crypto.doVerify per signature, serializedHash + MerkleTree per
+component), re-designed as batched, fixed-shape JAX programs:
+
+- Everything is traced once per (batch-shape) and compiled by XLA; no Python in
+  the loop.
+- 256-bit field elements are 16×16-bit limbs held in uint64 lanes (products of
+  limbs fit exactly; column sums stay < 2^37), so the VPU does the bigint work.
+- Multi-chip fan-out shards the batch dimension over the mesh (corda_tpu.parallel).
+
+x64 note: importing this package enables jax_enable_x64 (the limb arithmetic and
+SHA-512-free design rely on 64-bit lanes).
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from . import sha256  # noqa: E402,F401
+
+__all__ = ["sha256"]

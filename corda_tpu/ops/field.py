@@ -1,0 +1,241 @@
+"""Batched 256-bit prime-field arithmetic on 16×16-bit limbs in uint64 lanes.
+
+The bigint engine under both curve kernels (ed25519.py, secp256k1.py). Design
+(SURVEY.md §7 phase 1 "limb-decomposed lanes"):
+
+- A field element is ``u64[..., 16]``, little-endian 16-bit limbs (limb i holds
+  bits [16i, 16i+16)). Canonical form: every limb < 2^16 and the value < p.
+- Schoolbook multiply: 256 exact u64 limb products accumulated into 31 columns
+  (column sums < 2^37 — far from u64 overflow), then a sequential carry sweep.
+- Reduction exploits 16-limb alignment of 2^256 ≡ fold_c (mod p):
+  p25519 = 2^255-19 → fold_c = 38;  psecp = 2^256-2^32-977 → fold_c = 2^32+977.
+  Three folds + two branchless conditional subtractions fully canonicalise any
+  512-bit product (bounds argued inline).
+- Subtraction avoids borrows-of-borrows by adding a redundant-limb encoding of
+  4p whose every limb dominates a canonical limb.
+- No data-dependent control flow anywhere: fixed-shape VPU vector code under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NLIMB = 16
+LIMB_BITS = 16
+MASK = (1 << LIMB_BITS) - 1
+
+P25519 = 2**255 - 19
+PSECP = 2**256 - 2**32 - 977
+
+_FOLD = {P25519: 38, PSECP: 2**32 + 977}
+
+
+# ---------------------------------------------------------------------------
+# Host <-> limb conversion
+# ---------------------------------------------------------------------------
+
+def to_limbs(x, n: int = NLIMB) -> np.ndarray:
+    """Python int(s) → u64 limb array ((n,) or (B, n))."""
+    if isinstance(x, (int, np.integer)):
+        return np.array([(int(x) >> (LIMB_BITS * i)) & MASK for i in range(n)],
+                        dtype=np.uint64)
+    return np.stack([to_limbs(int(v), n) for v in x])
+
+
+def from_limbs(a):
+    """u64 limb array → Python int(s)."""
+    arr = np.asarray(a, dtype=np.uint64)
+    if arr.ndim == 1:
+        return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr))
+    return [from_limbs(row) for row in arr]
+
+
+def _fold_c_limbs(p: int) -> list[int]:
+    """fold_c as its (≤3) non-zero-bounded limbs."""
+    return [int(v) for v in to_limbs(_FOLD[p], 3)]
+
+
+# 4p in a redundant limb encoding where limbs 0..15 each dominate a canonical
+# limb (≥ 2^16 - 1), used for borrow-free subtraction. 17 limbs total.
+def _four_p_offset(p: int) -> np.ndarray:
+    base = to_limbs(4 * p, 17)
+    c = base.astype(np.int64)
+    c[0] += 1 << LIMB_BITS
+    for i in range(1, NLIMB):
+        c[i] += (1 << LIMB_BITS) - 1
+    c[NLIMB] -= 1
+    assert c[NLIMB] >= 0 and all(v >= MASK for v in c[:NLIMB])
+    assert sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(c)) == 4 * p
+    return c.astype(np.uint64)
+
+
+_OFFSETS = {p: _four_p_offset(p) for p in (P25519, PSECP)}
+
+
+# ---------------------------------------------------------------------------
+# Carry handling and canonicalisation
+# ---------------------------------------------------------------------------
+
+def carry_sweep(a):
+    """Propagate carries so every limb < 2^16. ``a``: (..., n) u64 with limbs
+    < 2^48. Returns (swept (..., n), residual carry (...,))."""
+    n = a.shape[-1]
+    out = []
+    carry = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
+    for i in range(n):
+        v = a[..., i] + carry
+        out.append(v & MASK)
+        carry = v >> LIMB_BITS
+    return jnp.stack(out, axis=-1), carry
+
+
+def cond_sub_p(a, p: int):
+    """Branchless ``a - p if a >= p else a`` for swept 16-limb ``a``."""
+    p_limbs = jnp.asarray(to_limbs(p))
+    ge = jnp.ones(a.shape[:-1], dtype=jnp.bool_)
+    decided = jnp.zeros(a.shape[:-1], dtype=jnp.bool_)
+    for i in range(NLIMB - 1, -1, -1):
+        ai = a[..., i]
+        pi = p_limbs[i]
+        gt, lt = ai > pi, ai < pi
+        ge = jnp.where(decided, ge, jnp.where(gt, True, jnp.where(lt, False, ge)))
+        decided = decided | gt | lt
+    borrow = jnp.zeros(a.shape[:-1], dtype=jnp.uint64)
+    outs = []
+    for i in range(NLIMB):
+        v = a[..., i] - p_limbs[i] - borrow
+        borrow = (v >> 63) & 1  # u64 wraparound ⇒ borrow
+        outs.append(v & MASK)
+    sub16 = jnp.stack(outs, axis=-1)
+    return jnp.where(ge[..., None], sub16, a)
+
+
+def _fold(limbs, p: int):
+    """lo + (value >> 256) * fold_c: input (..., n>16) swept limbs, output swept
+    limbs (possibly still > 16 wide by the residual carry limb)."""
+    lo, hi = limbs[..., :NLIMB], limbs[..., NLIMB:]
+    nh = hi.shape[-1]
+    acc = jnp.zeros(limbs.shape[:-1] + (NLIMB + nh + 3,), dtype=jnp.uint64)
+    acc = acc.at[..., :NLIMB].add(lo)
+    for j, c in enumerate(_fold_c_limbs(p)):
+        if c:
+            acc = acc.at[..., j:j + nh].add(hi * jnp.uint64(c))
+    swept, carry = carry_sweep(acc)
+    # trim statically-zero top: value < 2^(16·(n)) bound shrinks every fold
+    return jnp.concatenate([swept, carry[..., None]], axis=-1)
+
+
+def _shrink(limbs):
+    """Drop top limbs that are provably zero by value-bound accounting: callers
+    only invoke when the bound guarantees ≤ the kept width."""
+    return limbs
+
+
+def reduce_wide(limbs, p: int):
+    """Fully reduce swept limbs of any width ≤ 33 to canonical 16 limbs.
+
+    Bound walk for a 512-bit product: V0 < 2^512 → V1 = lo + (V0»256)·fold_c
+    < 2^256 + 2^256·fold_c < 2^290 → V2 < 2^256 + 2^34·fold_c < 2^256 + 2^67
+    → V3 < 2^256 + 2·fold_c < 2^256 + 2^34 < 3p → two conditional subtracts."""
+    v = limbs
+    for _ in range(3):
+        if v.shape[-1] <= NLIMB:
+            break
+        v = _fold(v, p)
+        # width bookkeeping: after the first fold the value fits well inside
+        # NLIMB+4 limbs; slicing is safe because higher limbs are zero.
+        if v.shape[-1] > NLIMB + 4:
+            v = v[..., :NLIMB + 4]
+    if v.shape[-1] > NLIMB:
+        v = _fold(v, p)[..., :NLIMB]
+    v = cond_sub_p(v, p)
+    return cond_sub_p(v, p)
+
+
+# ---------------------------------------------------------------------------
+# Core modular ops (shape-polymorphic over leading batch dims)
+# ---------------------------------------------------------------------------
+
+def raw_mul(a, b):
+    """Full product: (..., 16) × (..., 16) → (..., 32) swept u64 limbs."""
+    cols = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+                     + (2 * NLIMB - 1,), dtype=jnp.uint64)
+    for i in range(NLIMB):
+        cols = cols.at[..., i:i + NLIMB].add(a[..., i:i + 1] * b)
+    limbs, carry = carry_sweep(cols)
+    return jnp.concatenate([limbs, carry[..., None]], axis=-1)
+
+
+def mul(a, b, p: int):
+    """Canonical modular multiply."""
+    return reduce_wide(raw_mul(a, b), p)
+
+
+def sqr(a, p: int):
+    return mul(a, a, p)
+
+
+def add(a, b, p: int):
+    s, carry = carry_sweep(a + b)
+    wide = jnp.concatenate([s, carry[..., None]], axis=-1)
+    return reduce_wide(wide, p)
+
+
+def sub(a, b, p: int):
+    """a - b mod p via the borrow-free 4p offset: a + (4p-as-dominating-limbs) - b."""
+    off = jnp.asarray(_OFFSETS[p])
+    t = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]) + (NLIMB + 1,),
+                  dtype=jnp.uint64)
+    t = t.at[..., :NLIMB].add(a + off[:NLIMB] - b)
+    t = t.at[..., NLIMB].add(off[NLIMB])
+    swept, carry = carry_sweep(t)
+    wide = jnp.concatenate([swept, carry[..., None]], axis=-1)
+    return reduce_wide(wide, p)
+
+
+def neg(a, p: int):
+    return sub(jnp.zeros_like(a), a, p)
+
+
+def mul_const(a, c: int, p: int):
+    """Multiply by a small host constant (≤ 2^48): scale limbs then reduce."""
+    prod = a * jnp.uint64(c)
+    swept, carry = carry_sweep(prod)
+    wide = jnp.concatenate([swept, carry[..., None]], axis=-1)
+    return reduce_wide(wide, p)
+
+
+# ---------------------------------------------------------------------------
+# Predicates / selection
+# ---------------------------------------------------------------------------
+
+def eq(a, b):
+    """Limb-exact equality of canonical elements → bool (...,)."""
+    return jnp.all(a == b, axis=-1)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def select(cond, a, b):
+    """cond (...,) bool → where(cond, a, b) over limb arrays."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def pow_const(a, e: int, p: int):
+    """a^e for a host-known exponent via square-and-multiply (fixed unroll —
+    used for device-side sqrt/inversion with Fermat exponents)."""
+    result = jnp.zeros_like(a).at[..., 0].set(1)
+    base = a
+    for bit in bin(e)[2:]:
+        result = sqr(result, p)
+        if bit == "1":
+            result = mul(result, base, p)
+    return result
+
+
+def inv(a, p: int):
+    """Modular inverse via Fermat (a^(p-2)); a must be non-zero."""
+    return pow_const(a, p - 2, p)

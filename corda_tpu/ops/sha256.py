@@ -1,0 +1,158 @@
+"""Batched SHA-256 as a JAX program (uint32 lanes, fully vectorized over batch).
+
+Replaces the per-component host hashing of the reference's Merkle path
+(MerkleTransaction.kt:16-18 serializedHash; MerkleTree.kt:27-66 tree build;
+SecureHash.kt:24 single-SHA-256 node combine) with device-batched equivalents:
+
+- ``sha256_blocks``: hash B messages of a common block count in one call.
+- ``hash_pairs``: one Merkle level — SHA-256 of 64-byte (left‖right) pairs.
+- ``merkle_root``: full tree over a power-of-two leaf batch on device.
+
+Bit-exact against hashlib (differentially tested in tests/test_ops_sha256.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+
+_IV = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19], dtype=np.uint32)
+
+# Constant second block for 64-byte messages: 0x80 marker then length 512 bits.
+_PAD_BLOCK_64B = np.zeros(16, dtype=np.uint32)
+_PAD_BLOCK_64B[0] = 0x80000000
+_PAD_BLOCK_64B[15] = 512
+
+
+def _rotr(x, n: int):
+    return (x >> n) | (x << (32 - n))
+
+
+def compress(state, block):
+    """One SHA-256 compression: ``state`` (..., 8) u32, ``block`` (..., 16) u32."""
+    w = [block[..., i] for i in range(16)]
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> 3)
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> 10)
+        w.append(w[t - 16] + s0 + w[t - 7] + s1)
+    a, b, c, d, e, f, g, h = (state[..., i] for i in range(8))
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + jnp.uint32(_K[t]) + w[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
+    return state + out
+
+
+@jax.jit
+def _sha256_blocks_impl(blocks):
+    state = jnp.broadcast_to(jnp.asarray(_IV), blocks.shape[:-2] + (8,))
+    # scan over the block axis (graph stays one-compression-sized for any length)
+    blocks_first = jnp.moveaxis(blocks, -2, 0)
+
+    def step(st, blk):
+        return compress(st, blk), None
+
+    state, _ = jax.lax.scan(step, state, blocks_first)
+    return state
+
+
+def sha256_blocks(blocks) -> jax.Array:
+    """Hash a batch of pre-padded messages: ``blocks`` (..., n_blocks, 16) u32
+    big-endian words → digests (..., 8) u32."""
+    blocks = jnp.asarray(blocks, dtype=jnp.uint32)
+    return _sha256_blocks_impl(blocks)
+
+
+@jax.jit
+def hash_pairs(pairs) -> jax.Array:
+    """One Merkle level: ``pairs`` (..., 16) u32 = left‖right digests (64 bytes)
+    → SHA-256 digests (..., 8) u32. Single-SHA-256 node combine (SecureHash.kt:36)."""
+    pairs = jnp.asarray(pairs, dtype=jnp.uint32)
+    state = jnp.broadcast_to(jnp.asarray(_IV), pairs.shape[:-1] + (8,))
+    state = compress(state, pairs)
+    pad = jnp.broadcast_to(jnp.asarray(_PAD_BLOCK_64B), pairs.shape[:-1] + (16,))
+    return compress(state, pad)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _merkle_root_impl(leaves, n: int):
+    level = leaves
+    while n > 1:
+        pairs = level.reshape(level.shape[:-2] + (n // 2, 16))
+        level = hash_pairs(pairs)
+        n //= 2
+    return level[..., 0, :]
+
+
+def merkle_root(leaves) -> jax.Array:
+    """Merkle root over (..., N, 8) u32 leaf digests, N a power of two (callers
+    zero-pad per MerkleTree.kt:27-41). Returns (..., 8) u32."""
+    leaves = jnp.asarray(leaves, dtype=jnp.uint32)
+    n = leaves.shape[-2]
+    if n & (n - 1):
+        raise ValueError("merkle_root requires a power-of-two leaf count (zero-pad)")
+    if n == 1:
+        return leaves[..., 0, :]
+    return _merkle_root_impl(leaves, n)
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing helpers
+# ---------------------------------------------------------------------------
+
+def pad_message(data: bytes, n_blocks: int | None = None) -> np.ndarray:
+    """SHA-256 padding → (n_blocks, 16) u32 big-endian words."""
+    bit_len = len(data) * 8
+    padded = data + b"\x80"
+    while len(padded) % 64 != 56:
+        padded += b"\x00"
+    padded += bit_len.to_bytes(8, "big")
+    arr = np.frombuffer(padded, dtype=">u4").astype(np.uint32).reshape(-1, 16)
+    if n_blocks is not None:
+        if arr.shape[0] > n_blocks:
+            raise ValueError("message longer than n_blocks")
+        if arr.shape[0] < n_blocks:
+            raise ValueError("pad_message produces exact block count; bucket messages "
+                             "by size before batching")
+    return arr
+
+
+def pack_batch(messages: list[bytes]) -> np.ndarray:
+    """Pack equal-block-count messages into (B, n_blocks, 16) u32."""
+    arrs = [pad_message(m) for m in messages]
+    n = arrs[0].shape[0]
+    if any(a.shape[0] != n for a in arrs):
+        raise ValueError("all messages in a batch must pad to the same block count")
+    return np.stack(arrs)
+
+
+def digests_to_bytes(digests) -> list[bytes]:
+    """(B, 8) u32 → list of 32-byte digests."""
+    arr = np.asarray(digests, dtype=np.uint32).astype(">u4")
+    return [arr[i].tobytes() for i in range(arr.shape[0])]
+
+
+def digests_from_bytes(hashes: list[bytes]) -> np.ndarray:
+    """list of 32-byte digests → (B, 8) u32."""
+    return np.stack([np.frombuffer(h, dtype=">u4").astype(np.uint32) for h in hashes])

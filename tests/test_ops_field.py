@@ -1,0 +1,63 @@
+"""Differential tests of the device limb field arithmetic vs Python ints."""
+import numpy as np
+import pytest
+
+from corda_tpu.ops import field as F
+
+RNG = np.random.default_rng(42)
+PRIMES = [F.P25519, F.PSECP]
+
+
+def rand_elems(p, n=64):
+    vals = [int.from_bytes(RNG.bytes(32), "little") % p for _ in range(n)]
+    # include edge cases
+    vals[:6] = [0, 1, p - 1, p - 2, (1 << 255) % p, (p - 1) // 2]
+    return vals
+
+
+@pytest.mark.parametrize("p", PRIMES)
+def test_limb_roundtrip(p):
+    vals = rand_elems(p)
+    assert F.from_limbs(F.to_limbs(vals)) == vals
+
+
+@pytest.mark.parametrize("p", PRIMES)
+def test_mul(p):
+    a, b = rand_elems(p), rand_elems(p)
+    out = F.from_limbs(F.mul(F.to_limbs(a), F.to_limbs(b), p))
+    assert out == [(x * y) % p for x, y in zip(a, b)]
+
+
+@pytest.mark.parametrize("p", PRIMES)
+def test_add_sub_neg(p):
+    a, b = rand_elems(p), rand_elems(p)
+    la, lb = F.to_limbs(a), F.to_limbs(b)
+    assert F.from_limbs(F.add(la, lb, p)) == [(x + y) % p for x, y in zip(a, b)]
+    assert F.from_limbs(F.sub(la, lb, p)) == [(x - y) % p for x, y in zip(a, b)]
+    assert F.from_limbs(F.neg(la, p)) == [(-x) % p for x in a]
+
+
+@pytest.mark.parametrize("p", PRIMES)
+def test_mul_const(p):
+    a = rand_elems(p)
+    for c in [0, 1, 2, 8, 38, 977, 121666]:
+        out = F.from_limbs(F.mul_const(F.to_limbs(a), c, p))
+        assert out == [(x * c) % p for x in a]
+
+
+@pytest.mark.parametrize("p", PRIMES)
+def test_predicates(p):
+    a = rand_elems(p, 8)
+    la = F.to_limbs(a)
+    assert list(np.asarray(F.eq(la, la))) == [True] * 8
+    assert list(np.asarray(F.is_zero(la))) == [v == 0 for v in a]
+    lb = F.to_limbs(a[::-1])
+    assert list(np.asarray(F.eq(la, lb))) == [x == y for x, y in zip(a, a[::-1])]
+
+
+@pytest.mark.parametrize("p", PRIMES)
+def test_pow_small(p):
+    a = rand_elems(p, 8)
+    la = F.to_limbs(a)
+    out = F.from_limbs(F.pow_const(la, 65537, p))
+    assert out == [pow(x, 65537, p) for x in a]

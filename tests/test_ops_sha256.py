@@ -1,0 +1,56 @@
+"""Device SHA-256 / Merkle kernels: bit-exact differential tests vs hashlib and
+the host MerkleTree (the reference-parity oracle)."""
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from corda_tpu.core.crypto import MerkleTree, SecureHash
+from corda_tpu.ops import sha256 as dsha
+
+
+def test_sha256_single_block_messages():
+    msgs = [b"", b"abc", b"a" * 55]  # all pad to 1 block
+    batch = dsha.pack_batch(msgs)
+    out = dsha.digests_to_bytes(dsha.sha256_blocks(batch))
+    for m, d in zip(msgs, out):
+        assert d == hashlib.sha256(m).digest()
+
+
+def test_sha256_multi_block_messages():
+    msgs = [os.urandom(100) for _ in range(8)]  # 100B -> 2 blocks
+    out = dsha.digests_to_bytes(dsha.sha256_blocks(dsha.pack_batch(msgs)))
+    for m, d in zip(msgs, out):
+        assert d == hashlib.sha256(m).digest()
+    # longer: 1000B -> 16 blocks
+    msgs = [os.urandom(1000) for _ in range(4)]
+    out = dsha.digests_to_bytes(dsha.sha256_blocks(dsha.pack_batch(msgs)))
+    for m, d in zip(msgs, out):
+        assert d == hashlib.sha256(m).digest()
+
+
+def test_hash_pairs_matches_hash_concat():
+    rng = np.random.default_rng(0)
+    left = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(16)]
+    right = [bytes(rng.integers(0, 256, 32, dtype=np.uint8)) for _ in range(16)]
+    pairs = np.concatenate([dsha.digests_from_bytes(left),
+                            dsha.digests_from_bytes(right)], axis=1)
+    out = dsha.digests_to_bytes(dsha.hash_pairs(pairs))
+    for l, r, d in zip(left, right, out):
+        assert d == SecureHash(l).hash_concat(SecureHash(r)).bytes
+
+
+@pytest.mark.parametrize("n_leaves", [1, 2, 8, 64, 256])
+def test_merkle_root_matches_host_tree(n_leaves):
+    leaves = [SecureHash.sha256(bytes([i % 256, i // 256])) for i in range(n_leaves)]
+    from corda_tpu.core.crypto.merkle import pad_to_power_of_two
+    padded = pad_to_power_of_two(leaves)
+    dev = dsha.merkle_root(dsha.digests_from_bytes([h.bytes for h in padded]))
+    host = MerkleTree.get_merkle_tree(leaves).hash
+    assert dsha.digests_to_bytes(dev[None])[0] == host.bytes
+
+
+def test_merkle_root_rejects_non_pow2():
+    with pytest.raises(ValueError):
+        dsha.merkle_root(np.zeros((3, 8), dtype=np.uint32))
