@@ -55,37 +55,31 @@ class ContractState:
 
 
 class OwnableState(ContractState):
-    """A state with a single owner key, supporting ownership transfer."""
+    """A state with a single owner key, supporting ownership transfer.
 
-    @property
-    def owner(self) -> PublicKey:
-        raise NotImplementedError
+    Interface contract (duck-typed so dataclass subclasses can declare the
+    attributes as fields): `owner: PublicKey`, and
+    `with_new_owner(new_owner) -> (CommandData, OwnableState)`.
+    """
 
     def with_new_owner(self, new_owner: PublicKey) -> tuple["CommandData", "OwnableState"]:
         raise NotImplementedError
 
 
 class LinearState(ContractState):
-    """A state evolving through a chain of transactions, tracked by linear_id."""
-
-    @property
-    def linear_id(self) -> "UniqueIdentifier":
-        raise NotImplementedError
+    """A state evolving through a chain of transactions, tracked by a
+    `linear_id: UniqueIdentifier` attribute (duck-typed, see OwnableState)."""
 
     def is_relevant(self, our_keys: set[PublicKey]) -> bool:
         return any(k in our_keys for p in self.participants for k in p.keys)
 
 
 class FungibleAsset(OwnableState):
-    """An ownable, splittable/mergeable amount of an issued product (Cash etc.)."""
+    """An ownable, splittable/mergeable amount of an issued product (Cash etc.).
 
-    @property
-    def amount(self):  # Amount[Issued[T]]
-        raise NotImplementedError
-
-    @property
-    def exit_keys(self) -> set[PublicKey]:
-        raise NotImplementedError
+    Interface contract: `amount: Amount[Issued[T]]`, `exit_keys: set[PublicKey]`
+    (duck-typed, see OwnableState).
+    """
 
 
 @serializable("ScheduledActivity")

@@ -1,0 +1,4 @@
+"""Finance: reference contracts + flows (the `finance/` module of the
+reference — Cash, CommercialPaper, Obligation and the cash flows)."""
+from .cash import Cash, CashState  # noqa: F401
+from .flows import CashIssueFlow, CashPaymentFlow, CashExitFlow  # noqa: F401

@@ -1,0 +1,405 @@
+"""Library flows: notarisation, finality, broadcast, resolution, signing.
+
+Reference parity (core/src/main/kotlin/net/corda/core/flows/):
+- NotaryFlow.Client/Service (NotaryFlow.kt:31-120)
+- FinalityFlow (FinalityFlow.kt:36,86-98): notarise → record → broadcast
+- BroadcastTransactionFlow + NotifyTransactionHandler (CoreFlowHandlers.kt)
+- FetchTransactionsFlow / FetchDataFlow (hash-addressed download + check)
+- ResolveTransactionsFlow (dependency-graph walk, topological order, 5000-tx
+  cap — ResolveTransactionsFlow.kt:31,40,98,134)
+- CollectSignaturesFlow / SignTransactionFlow (CollectSignaturesFlow.kt:1-258)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from ..core.crypto.signatures import DigitalSignatureWithKey
+from ..core.serialization import register_type
+from ..core.transactions.signed import SignedTransaction
+from .api import (FlowException, FlowLogic, Receive, Send, SendAndReceive,
+                  initiating_flow)
+
+MAX_RESOLVE_TRANSACTIONS = 5000  # ResolveTransactionsFlow.kt partial-tx cap
+
+
+# ---------------------------------------------------------------------------
+# Wire payloads
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class NotarisationRequest:
+    stx: Any                  # SignedTransaction (validating) or filtered form
+
+
+@dataclass(frozen=True)
+class FetchTransactionsRequest:
+    tx_ids: tuple             # SecureHash...
+
+
+@dataclass(frozen=True)
+class NotifyTxRequest:
+    stx: Any
+
+
+@dataclass(frozen=True)
+class SignTransactionRequest:
+    stx: Any
+
+
+for _cls in (NotarisationRequest, FetchTransactionsRequest, NotifyTxRequest,
+             SignTransactionRequest):
+    register_type(f"flows.{_cls.__name__}", _cls)
+
+
+class NotaryException(FlowException):
+    """Conflict or rejection from the notary (NotaryException.Conflict)."""
+
+
+# ---------------------------------------------------------------------------
+# Notarisation
+# ---------------------------------------------------------------------------
+
+@initiating_flow
+class NotaryFlow(FlowLogic):
+    """Client side (NotaryFlow.Client, NotaryFlow.kt:31-44): request a notary
+    signature over the transaction; raises NotaryException on conflict."""
+
+    def __init__(self, stx: SignedTransaction):
+        self.stx = stx
+
+    def call(self):
+        notary = self.stx.notary
+        if notary is None:
+            raise FlowException("Transaction has no notary set")
+        try:
+            resp = yield SendAndReceive(notary, NotarisationRequest(self.stx),
+                                        DigitalSignatureWithKey)
+        except FlowException as e:
+            raise NotaryException(str(e)) from e
+
+        def validate(sig):
+            if not isinstance(sig, DigitalSignatureWithKey):
+                raise FlowException(f"Notary returned {type(sig).__name__}")
+            if not notary.owning_key.is_fulfilled_by(sig.by):
+                raise FlowException("Notary signature by an unexpected key")
+            sig.verify(self.stx.id.bytes)
+            return sig
+
+        return [resp.unwrap(validate)]
+
+
+class NotaryServiceFlow(FlowLogic):
+    """Service side (NotaryFlow.Service, NotaryFlow.kt:95-120), instantiated
+    per request by the notary node's installed NotaryService. Validating
+    services fully verify first (ValidatingNotaryFlow); both check the time
+    window and commit input uniqueness before signing."""
+
+    def __init__(self, peer, service):
+        self.peer = peer
+        self.service = service
+
+    def call(self):
+        req = yield Receive(self.peer, NotarisationRequest)
+        stx = req.unwrap(lambda r: r.stx if isinstance(r, NotarisationRequest)
+                         else _reject("Expected a NotarisationRequest"))
+        if self.service.validating:
+            # resolve dependencies from the requester, then fully verify
+            yield from self.sub_flow(ResolveTransactionsFlow(
+                self.peer, stx=stx))
+            stx.verify(self.service.hub, check_sufficient_signatures=False)
+        if not self.service.time_window_checker.is_valid(stx.tx.time_window):
+            raise FlowException("Transaction time-window is outside tolerance")
+        try:
+            self.service.commit(stx.inputs, stx.id, str(self.peer.name))
+        except Exception as e:
+            raise FlowException(str(e)) from e
+        sig = self.service.sign_tx_id(stx.id)
+        yield Send(self.peer, sig)
+        return None
+
+
+def _reject(msg: str):
+    raise FlowException(msg)
+
+
+# ---------------------------------------------------------------------------
+# Fetch / resolve
+# ---------------------------------------------------------------------------
+
+@initiating_flow
+class FetchTransactionsFlow(FlowLogic):
+    """Download transactions by id from a peer, verifying each returned blob
+    hashes to its requested id (FetchDataFlow's maybeCheckHash)."""
+
+    def __init__(self, peer, tx_ids):
+        self.peer = peer
+        self.tx_ids = tuple(tx_ids)
+
+    def call(self):
+        from_disk, to_fetch = [], []
+        for tx_id in self.tx_ids:
+            stx = self.service_hub.storage.get_transaction(tx_id)
+            (from_disk if stx is not None else to_fetch).append(stx or tx_id)
+        if not to_fetch:
+            return from_disk
+        resp = yield SendAndReceive(self.peer,
+                                    FetchTransactionsRequest(tuple(to_fetch)),
+                                    list)
+
+        def validate(stxs):
+            if len(stxs) != len(to_fetch):
+                raise FlowException("Peer returned wrong number of transactions")
+            for tx_id, stx in zip(to_fetch, stxs):
+                if not isinstance(stx, SignedTransaction) or stx.id != tx_id:
+                    raise FlowException(
+                        f"Peer returned a transaction that hashes to {stx.id} "
+                        f"instead of the requested {tx_id}")
+            return list(stxs)
+
+        return from_disk + resp.unwrap(validate)
+
+
+class FetchTransactionsHandler(FlowLogic):
+    """Serves FetchTransactionsFlow requests from local storage — installed on
+    every node (installCoreFlows, AbstractNode.kt:285)."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        req = yield Receive(self.peer, FetchTransactionsRequest)
+        tx_ids = req.unwrap(lambda r: r.tx_ids)
+        out = []
+        for tx_id in tx_ids:
+            stx = self.service_hub.storage.get_transaction(tx_id)
+            if stx is None:
+                raise FlowException(f"Transaction {tx_id} not found")
+            out.append(stx)
+        yield Send(self.peer, out)
+        return None
+
+
+@initiating_flow
+class ResolveTransactionsFlow(FlowLogic):
+    """Breadth-first dependency download + topological verify+record
+    (ResolveTransactionsFlow.kt:31-134): walks stx.inputs' txhashes back,
+    fetches unseen ones from the peer, verifies in dependency order, records.
+    Hard cap of 5000 transactions per walk."""
+
+    def __init__(self, peer, tx_ids=None, stx: SignedTransaction | None = None):
+        self.peer = peer
+        self.tx_ids = tuple(tx_ids) if tx_ids else ()
+        self.stx = stx
+
+    def call(self):
+        hub = self.service_hub
+        frontier = list(self.tx_ids)
+        if self.stx is not None:
+            frontier.extend(ref.txhash for ref in self.stx.inputs)
+        fetched: dict = {}
+        seen = set(frontier)
+        queue = [tx_id for tx_id in frontier
+                 if hub.storage.get_transaction(tx_id) is None]
+        while queue:
+            if len(fetched) + len(queue) > MAX_RESOLVE_TRANSACTIONS:
+                raise FlowException(
+                    f"Transaction resolution exceeds the {MAX_RESOLVE_TRANSACTIONS} limit")
+            batch = queue[:50]  # fetch in pages
+            queue = queue[50:]
+            stxs = yield from self.sub_flow(
+                FetchTransactionsFlow(self.peer, batch))
+            for stx in stxs:
+                fetched[stx.id] = stx
+                for ref in stx.inputs:
+                    dep = ref.txhash
+                    if dep not in seen:
+                        seen.add(dep)
+                        if hub.storage.get_transaction(dep) is None:
+                            queue.append(dep)
+        # topological order: dependencies before dependents
+        order = _topological_order(fetched)
+        for stx in order:
+            stx.verify(hub, check_sufficient_signatures=False)
+            hub.record_transactions(stx)
+        return [stx.id for stx in order]
+
+
+def _topological_order(txs: dict) -> list:
+    """Kahn's algorithm over the fetched set (dependencies first)."""
+    pending = dict(txs)
+    ordered = []
+    while pending:
+        progressed = False
+        for tx_id, stx in list(pending.items()):
+            if all(ref.txhash not in pending for ref in stx.inputs):
+                ordered.append(stx)
+                del pending[tx_id]
+                progressed = True
+        if not progressed:
+            raise FlowException("Transaction dependency cycle detected")
+    return ordered
+
+
+# ---------------------------------------------------------------------------
+# Broadcast / finality
+# ---------------------------------------------------------------------------
+
+@initiating_flow
+class BroadcastTransactionFlow(FlowLogic):
+    """Send a finalised transaction to each participant
+    (BroadcastTransactionFlow → NotifyTransactionHandler)."""
+
+    def __init__(self, stx: SignedTransaction, participants):
+        self.stx = stx
+        self.participants = tuple(participants)
+
+    def call(self):
+        me = str(self.service_hub.my_info.legal_identity.name)
+        sent = {me}
+        for party in self.participants:
+            if str(party.name) in sent:
+                continue
+            sent.add(str(party.name))
+            yield Send(party, NotifyTxRequest(self.stx))
+        return None
+
+
+class NotifyTransactionHandler(FlowLogic):
+    """Receives a broadcast transaction: resolve deps from the sender, verify,
+    record (CoreFlowHandlers.kt NotifyTransactionHandler)."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        req = yield Receive(self.peer, NotifyTxRequest)
+        stx = req.unwrap(lambda r: r.stx)
+        yield from self.sub_flow(ResolveTransactionsFlow(self.peer, stx=stx))
+        stx.verify(self.service_hub, check_sufficient_signatures=False)
+        self.service_hub.record_transactions(stx)
+        return None
+
+
+@initiating_flow
+class FinalityFlow(FlowLogic):
+    """Notarise (if needed), record locally, broadcast to participants
+    (FinalityFlow.kt:36,86-98)."""
+
+    def __init__(self, stx: SignedTransaction, extra_recipients=()):
+        self.stx = stx
+        self.extra_recipients = tuple(extra_recipients)
+
+    def call(self):
+        hub = self.service_hub
+        stx = self.stx
+        needs_notary = stx.notary is not None and (
+            len(stx.inputs) > 0 or stx.tx.time_window is not None)
+        if needs_notary:
+            notary_sigs = yield from self.sub_flow(NotaryFlow(stx))
+            stx = stx.plus(*notary_sigs)
+        hub.record_transactions(stx)
+        participants = self._participant_parties(stx)
+        yield from self.sub_flow(
+            BroadcastTransactionFlow(stx, participants + list(self.extra_recipients)))
+        return stx
+
+    def _participant_parties(self, stx):
+        hub = self.service_hub
+        parties = []
+        seen = set()
+        for out in stx.tx.outputs:
+            for key in getattr(out.data, "participants", []):
+                owning = getattr(key, "owning_key", key)
+                party = hub.identity_service.party_from_key(owning) \
+                    if hasattr(hub.identity_service, "party_from_key") else None
+                if party is None:
+                    party = _party_by_key(hub, owning)
+                if party is not None and party.owning_key not in seen:
+                    seen.add(party.owning_key)
+                    parties.append(party)
+        return parties
+
+
+def _party_by_key(hub, key):
+    for info in hub.network_map_cache.all_nodes():
+        if info.legal_identity.owning_key == key:
+            return info.legal_identity
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Signature collection
+# ---------------------------------------------------------------------------
+
+@initiating_flow
+class CollectSignaturesFlow(FlowLogic):
+    """Collect signatures from every required signer other than ourselves and
+    the notary (CollectSignaturesFlow.kt:1-258)."""
+
+    def __init__(self, stx: SignedTransaction):
+        self.stx = stx
+
+    def call(self):
+        hub = self.service_hub
+        our_keys = hub.key_management.keys
+        notary_key = stx_notary_key = None
+        if self.stx.notary is not None:
+            notary_key = self.stx.notary.owning_key
+        stx = self.stx
+        for key in stx.tx.must_sign:
+            if key == notary_key or any(k in our_keys for k in key.keys):
+                continue
+            party = _party_by_key(hub, key)
+            if party is None:
+                raise FlowException(
+                    f"No well-known party found for signer {key.to_string_short()}")
+            resp = yield SendAndReceive(party, SignTransactionRequest(stx),
+                                        DigitalSignatureWithKey)
+
+            def validate(sig, _key=key):
+                sig.verify(stx.id.bytes)
+                if not _key.is_fulfilled_by({sig.by}):
+                    raise FlowException("Signature from an unexpected key")
+                return sig
+
+            stx = stx.plus(resp.unwrap(validate))
+        return stx
+
+
+def install_core_flows(smm) -> None:
+    """Register the always-on service handlers every node must serve
+    (AbstractNode.installCoreFlows, AbstractNode.kt:285)."""
+    from .api import flow_name
+    smm.register_flow_factory(flow_name(FetchTransactionsFlow),
+                              FetchTransactionsHandler)
+    smm.register_flow_factory(flow_name(BroadcastTransactionFlow),
+                              NotifyTransactionHandler)
+
+
+class SignTransactionFlow(FlowLogic):
+    """Counter-signer side (abstract in the reference; subclass and override
+    `check_transaction` to add business validation)."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def check_transaction(self, stx: SignedTransaction) -> None:
+        """Override for business checks; raise FlowException to refuse."""
+
+    def call(self):
+        req = yield Receive(self.peer, SignTransactionRequest)
+        stx = req.unwrap(lambda r: r.stx)
+        # the initiator must already have signed it
+        stx.check_signatures_are_valid()
+        self.check_transaction(stx)
+        hub = self.service_hub
+        our_key = next((k for k in stx.tx.must_sign
+                        for leaf in k.keys
+                        if leaf in hub.key_management.keys), None)
+        if our_key is None:
+            raise FlowException("Transaction does not require our signature")
+        leaf = next(k for k in our_key.keys if k in hub.key_management.keys)
+        sig = hub.key_management.sign(stx.id.bytes, leaf)
+        yield Send(self.peer, sig)
+        return None

@@ -1,0 +1,9 @@
+"""Messaging plane: service interface, in-memory deterministic bus, topics.
+
+Reference parity: MessagingService (node/services/messaging/Messaging.kt:1-230)
+and the deterministic InMemoryMessagingNetwork used by MockNetwork
+(test-utils/.../InMemoryMessagingNetwork.kt:47-79). The production DCN plane
+(gRPC/TCP mesh between TPU-host VMs) plugs in behind the same interface.
+"""
+from .messaging import Message, MessagingService, TopicSession  # noqa: F401
+from .inmemory import InMemoryMessagingNetwork  # noqa: F401

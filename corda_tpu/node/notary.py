@@ -1,0 +1,172 @@
+"""Notary services: uniqueness (double-spend prevention) + time-window check.
+
+Reference parity (node/services/transactions/ + core NotaryFlow.kt:95-120):
+- `UniquenessProvider.commit` with conflict reporting
+  (core/node/services/UniquenessProvider.kt, PersistentUniquenessProvider.kt:73-130)
+- `SimpleNotaryService` (non-validating) / `ValidatingNotaryService`
+  (SimpleNotaryService.kt:12-26, ValidatingNotaryService.kt:38-52)
+- `TimeWindowChecker` (services/TimeWindowChecker.kt)
+
+The Raft/BFT clustered backends plug in behind the same `UniquenessProvider`
+interface (corda_tpu.consensus, SURVEY.md §7 phase 5).
+"""
+from __future__ import annotations
+
+import datetime
+import os
+import threading
+from dataclasses import dataclass
+
+from ..core.contracts.structures import StateRef
+from ..core.identity import Party
+from ..core.serialization import deserialize, register_type, serialize
+
+
+@dataclass(frozen=True)
+class ConsumedStateDetails:
+    """Who consumed a state, in which transaction (UniquenessProvider.Conflict)."""
+
+    consuming_tx: object     # SecureHash
+    consuming_index: int
+    requesting_party: str
+
+
+register_type("notary.ConsumedStateDetails", ConsumedStateDetails)
+
+
+class UniquenessException(Exception):
+    def __init__(self, conflicts: dict):
+        super().__init__(f"Input states already consumed: {sorted(conflicts, key=repr)}")
+        self.conflicts = conflicts  # StateRef -> ConsumedStateDetails
+
+
+class UniquenessProvider:
+    """The notary commit-log SPI."""
+
+    def commit(self, states: list[StateRef], tx_id, caller: str) -> None:
+        raise NotImplementedError
+
+
+class InMemoryUniquenessProvider(UniquenessProvider):
+    """ThreadBox'd map semantics of PersistentUniquenessProvider.kt:73-130:
+    atomically check all inputs, record all or none, report ALL conflicts."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._consumed: dict[StateRef, ConsumedStateDetails] = {}
+
+    def commit(self, states, tx_id, caller: str) -> None:
+        with self._lock:
+            conflicts = {}
+            for i, ref in enumerate(states):
+                prev = self._consumed.get(ref)
+                if prev is not None and prev.consuming_tx != tx_id:
+                    conflicts[ref] = prev
+            if conflicts:
+                raise UniquenessException(conflicts)
+            for i, ref in enumerate(states):
+                self._consumed[ref] = ConsumedStateDetails(tx_id, i, caller)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._consumed)
+
+
+class FileUniquenessProvider(InMemoryUniquenessProvider):
+    """Durable commit log: append-only file of canonical-codec records, synced
+    before the commit is acknowledged (the JDBC commit-log analog)."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                for line in f.read().split(b"\n"):
+                    if line:
+                        ref, details = deserialize(line)
+                        self._consumed[ref] = details
+
+    def commit(self, states, tx_id, caller: str) -> None:
+        with self._lock:
+            conflicts = {}
+            for ref in states:
+                prev = self._consumed.get(ref)
+                if prev is not None and prev.consuming_tx != tx_id:
+                    conflicts[ref] = prev
+            if conflicts:
+                raise UniquenessException(conflicts)
+            with open(self.path, "ab") as f:
+                for i, ref in enumerate(states):
+                    details = ConsumedStateDetails(tx_id, i, caller)
+                    f.write(serialize([ref, details]) + b"\n")
+                f.flush()
+                os.fsync(f.fileno())
+                for i, ref in enumerate(states):
+                    self._consumed[ref] = ConsumedStateDetails(tx_id, i, caller)
+
+
+class TimeWindowChecker:
+    """services/TimeWindowChecker.kt: tolerance-adjusted containment of now."""
+
+    def __init__(self, clock=None, tolerance_s: float = 30.0):
+        self.clock = clock or (lambda: datetime.datetime.now(datetime.timezone.utc))
+        self.tolerance = datetime.timedelta(seconds=tolerance_s)
+
+    def is_valid(self, time_window) -> bool:
+        if time_window is None:
+            return True
+        from ..core.serialization.codec import exact_epoch_micros
+        now = exact_epoch_micros(self.clock())
+        tol = int(self.tolerance.total_seconds() * 1_000_000)
+        # TimeWindow bounds are epoch-microsecond ints (structures.TimeWindow)
+        if time_window.until_time is not None and now > time_window.until_time + tol:
+            return False
+        if time_window.from_time is not None and now < time_window.from_time - tol:
+            return False
+        return True
+
+
+class NotaryService:
+    """Base notary service installed on a notary node; registers its service
+    flow for NotaryFlow.Client inits (TrustedAuthorityNotaryService analog)."""
+
+    type_id = "corda.notary"
+    validating = False
+
+    def __init__(self, hub, uniqueness: UniquenessProvider | None = None,
+                 time_window_checker: TimeWindowChecker | None = None):
+        self.hub = hub
+        self.uniqueness = uniqueness if uniqueness is not None \
+            else InMemoryUniquenessProvider()
+        self.time_window_checker = time_window_checker or TimeWindowChecker()
+
+    def install(self, smm) -> None:
+        from ..flows.library import NotaryFlow, NotaryServiceFlow
+        from ..flows.api import flow_name
+        smm.register_flow_factory(
+            flow_name(NotaryFlow),
+            lambda peer: NotaryServiceFlow(peer, self))
+
+    def commit(self, input_refs, tx_id, caller_name: str) -> None:
+        self.uniqueness.commit(list(input_refs), tx_id, caller_name)
+
+    def sign_tx_id(self, tx_id):
+        return self.hub.sign(tx_id.bytes)
+
+
+class SimpleNotaryService(NotaryService):
+    """Non-validating: checks uniqueness + time window only
+    (SimpleNotaryService.kt:12-26)."""
+
+    type_id = "corda.notary.simple"
+    validating = False
+
+
+class ValidatingNotaryService(NotaryService):
+    """Validating: additionally resolves and fully verifies the transaction
+    before committing (ValidatingNotaryService.kt:38-52) — on this framework
+    the signature checks ride the TPU batcher when the hub's verifier service
+    is the TPU one."""
+
+    type_id = "corda.notary.validating"
+    validating = True

@@ -1,0 +1,145 @@
+"""RPC operations surface — the client-visible node API.
+
+Reference parity: CordaRPCOps (core/messaging/CordaRPCOps.kt:60-449, 54 ops)
+and CordaRPCOpsImpl (node/internal/CordaRPCOpsImpl.kt:1-199). The wire
+transport (queue-backed proxy with observable demux, RPCApi.kt/RPCServer.kt)
+plugs in behind this object; in-process callers (shell, tests, webserver
+equivalent) call it directly.
+
+Streaming (`DataFeed`) follows the reference shape: a snapshot plus a
+subscription handle; updates are delivered to registered callbacks (the Rx
+Observable analog on the deterministic host runtime).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..flows.api import FlowLogic, rpc_startable_flows, flow_name
+
+
+@dataclass
+class DataFeed:
+    """snapshot + live updates (CordaRPCOps DataFeed)."""
+
+    snapshot: Any
+    _subscribe: Callable[[Callable], None]
+
+    def subscribe(self, callback: Callable) -> None:
+        self._subscribe(callback)
+
+
+@dataclass(frozen=True)
+class StateMachineInfo:
+    run_id: str
+    flow_class: str
+    done: bool
+
+
+class FlowPermissionException(Exception):
+    pass
+
+
+class CordaRPCOps:
+    """The operation set served to clients (CordaRPCOps.kt:60+)."""
+
+    def __init__(self, hub, smm):
+        self.hub = hub
+        self.smm = smm
+
+    # -- node / network info -------------------------------------------------
+    def node_identity(self):
+        return self.hub.my_info
+
+    def network_map_snapshot(self) -> list:
+        return self.hub.network_map_cache.all_nodes()
+
+    def notary_identities(self) -> list:
+        return [n.notary_identity for n in self.hub.network_map_cache.notary_nodes()]
+
+    def current_node_time(self):
+        import datetime
+        return datetime.datetime.now(datetime.timezone.utc)
+
+    # -- flows ---------------------------------------------------------------
+    def registered_flows(self) -> list[str]:
+        return sorted(rpc_startable_flows())
+
+    def start_flow_dynamic(self, flow_class_or_name, *args, **kwargs):
+        """startFlowDynamic: only @StartableByRPC flows may be started
+        (CordaRPCOpsImpl.startFlowDynamic)."""
+        if isinstance(flow_class_or_name, str):
+            flows = rpc_startable_flows()
+            cls = flows.get(flow_class_or_name)
+            if cls is None:
+                matches = [c for n, c in flows.items()
+                           if n.rsplit(".", 1)[-1] == flow_class_or_name]
+                if len(matches) != 1:
+                    raise FlowPermissionException(
+                        f"Unknown or ambiguous flow {flow_class_or_name!r}")
+                cls = matches[0]
+        else:
+            cls = flow_class_or_name
+            if not getattr(cls, "_startable_by_rpc", False):
+                raise FlowPermissionException(
+                    f"{flow_name(cls)} is not annotated @StartableByRPC")
+        flow: FlowLogic = cls(*args, **kwargs)
+        return self.smm.add(flow)
+
+    def state_machines_snapshot(self) -> list[StateMachineInfo]:
+        return [StateMachineInfo(fsm.run_id, flow_name(type(fsm.flow)), fsm.done)
+                for fsm in self.smm.flows.values()]
+
+    def state_machines_feed(self) -> DataFeed:
+        def subscribe(cb):
+            self.smm.changes.append(
+                lambda event, fsm: cb((event, StateMachineInfo(
+                    fsm.run_id, flow_name(type(fsm.flow)), fsm.done))))
+        return DataFeed(self.state_machines_snapshot(), subscribe)
+
+    # -- ledger --------------------------------------------------------------
+    def verified_transactions_snapshot(self) -> list:
+        return self.hub.storage.transactions
+
+    def verified_transactions_feed(self) -> DataFeed:
+        def subscribe(cb):
+            self.hub.storage.add_commit_listener(cb)
+        return DataFeed(self.hub.storage.transactions, subscribe)
+
+    # -- vault ---------------------------------------------------------------
+    def vault_snapshot(self, state_type: type | None = None) -> list:
+        return self.hub.vault.unconsumed_states(state_type)
+
+    def vault_query(self, state_type: type | None = None,
+                    status: str = "unconsumed", **criteria) -> list:
+        return self.hub.vault.query(state_type, status=status, **criteria)
+
+    def vault_feed(self, state_type: type | None = None) -> DataFeed:
+        def subscribe(cb):
+            self.hub.vault.add_update_observer(cb)
+        return DataFeed(self.vault_snapshot(state_type), subscribe)
+
+    # -- attachments ---------------------------------------------------------
+    def upload_attachment(self, data: bytes):
+        return self.hub.attachments.import_attachment(data)
+
+    def open_attachment(self, att_id):
+        return self.hub.attachments.open_attachment(att_id)
+
+    def attachment_exists(self, att_id) -> bool:
+        return self.hub.attachments.has_attachment(att_id)
+
+    # -- identity ------------------------------------------------------------
+    def party_from_key(self, key):
+        return self.hub.identity_service.party_from_key(key)
+
+    def well_known_party_from_x500_name(self, name):
+        return self.hub.well_known_party(name)
+
+    def parties_from_name(self, query: str, exact: bool = False) -> set:
+        out = set()
+        for info in self.hub.network_map_cache.all_nodes():
+            name = str(info.legal_identity.name)
+            if (exact and query == name) or (not exact and query in name):
+                out.add(info.legal_identity)
+        return out

@@ -1,0 +1,231 @@
+"""ServiceHub: the service locator every flow and node component sees.
+
+Reference parity: ServiceHub (core/node/ServiceHub.kt), NodeInfo,
+TransactionStorage (Services.kt / storage SPI), NetworkMapCache lookups.
+The hub composes: messaging, validated-tx storage, identity, key management,
+attachments, the verifier service, and (when started) the state machine.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..core.contracts.structures import Attachment
+from ..core.crypto.keys import KeyPair, PublicKey
+from ..core.crypto.secure_hash import SecureHash
+from ..core.crypto.signatures import Crypto, DigitalSignatureWithKey
+from ..core.identity import Party
+
+
+class InMemoryAttachmentStorage:
+    """Content-addressed attachment store (NodeAttachmentService semantics:
+    import returns the hash id; open verifies by construction since the id IS
+    the hash — NodeAttachmentService.kt:35,148)."""
+
+    def __init__(self):
+        self._blobs: dict[SecureHash, bytes] = {}
+
+    def import_attachment(self, data: bytes) -> SecureHash:
+        att_id = SecureHash.sha256(data)
+        self._blobs.setdefault(att_id, bytes(data))
+        return att_id
+
+    def open_attachment(self, att_id: SecureHash) -> Attachment | None:
+        data = self._blobs.get(att_id)
+        return Attachment(att_id, data) if data is not None else None
+
+    def has_attachment(self, att_id: SecureHash) -> bool:
+        return att_id in self._blobs
+
+
+class InMemoryIdentityService:
+    """key → Party resolution (InMemoryIdentityService.kt:1-162)."""
+
+    def __init__(self, parties=()):
+        self._by_key: dict[PublicKey, Party] = {}
+        for p in parties:
+            self.register(p)
+
+    def register(self, party: Party) -> None:
+        self._by_key[party.owning_key] = party
+
+    def party_from_key(self, key: PublicKey) -> Party | None:
+        return self._by_key.get(key)
+
+    def parties_from_keys(self, keys) -> tuple[Party, ...]:
+        return tuple(p for p in (self._by_key.get(k) for k in keys)
+                     if p is not None)
+
+
+@dataclass(frozen=True)
+class ServiceInfo:
+    """An advertised service (notary etc.) — ServiceInfo/ServiceType analog."""
+
+    type: str           # e.g. "corda.notary.simple", "corda.notary.validating"
+    name: str | None = None
+
+
+@dataclass(frozen=True)
+class NodeInfo:
+    """Directory entry for a node (core NodeInfo: address + identity +
+    advertised services)."""
+
+    address: str
+    legal_identity: Party
+    advertised_services: tuple[ServiceInfo, ...] = ()
+
+    @property
+    def notary_identity(self) -> Party:
+        return self.legal_identity
+
+
+class TransactionStorage:
+    """Validated-transaction store with commit listeners
+    (DBTransactionStorage + its Rx `updates` feed analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._txs: dict = {}
+        self._listeners: list = []
+
+    def add_transaction(self, stx, notify: bool = True) -> bool:
+        with self._lock:
+            fresh = stx.id not in self._txs
+            if fresh:
+                self._txs[stx.id] = stx
+        if fresh and notify:
+            self.notify_listeners(stx)
+        return fresh
+
+    def notify_listeners(self, stx) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for cb in listeners:
+            cb(stx)
+
+    def get_transaction(self, tx_id):
+        with self._lock:
+            return self._txs.get(tx_id)
+
+    def add_commit_listener(self, cb) -> None:
+        with self._lock:
+            self._listeners.append(cb)
+
+    @property
+    def transactions(self) -> list:
+        with self._lock:
+            return list(self._txs.values())
+
+
+class KeyManagementService:
+    """Signing keys + fresh-key generation
+    (PersistentKeyManagementService / E2ETestKeyManagementService analog)."""
+
+    def __init__(self, key_pairs=()):
+        self._keys: dict[PublicKey, KeyPair] = {kp.public: kp for kp in key_pairs}
+
+    @property
+    def keys(self) -> set[PublicKey]:
+        return set(self._keys)
+
+    def fresh_key(self, scheme=None) -> KeyPair:
+        from ..core.crypto.keys import generate_keypair
+        from ..core.crypto.schemes import DEFAULT_SIGNATURE_SCHEME
+        kp = generate_keypair(scheme or DEFAULT_SIGNATURE_SCHEME)
+        self._keys[kp.public] = kp
+        return kp
+
+    def add(self, kp: KeyPair) -> None:
+        self._keys[kp.public] = kp
+
+    def key_pair(self, key: PublicKey) -> KeyPair:
+        kp = self._keys.get(key)
+        if kp is None:
+            raise ValueError(f"No private key known for {key.to_string_short()}")
+        return kp
+
+    def sign(self, content: bytes, key: PublicKey) -> DigitalSignatureWithKey:
+        return Crypto.sign_with_key(self.key_pair(key), content)
+
+
+class NetworkMapCache:
+    """name → NodeInfo directory (InMemoryNetworkMapCache analog; fed by the
+    network-map service or statically by MockNetwork)."""
+
+    def __init__(self):
+        self._nodes: dict[str, NodeInfo] = {}
+
+    def add_node(self, info: NodeInfo) -> None:
+        self._nodes[str(info.legal_identity.name)] = info
+
+    def remove_node(self, name: str) -> None:
+        self._nodes.pop(name, None)
+
+    def get_node_by_legal_name(self, name: str) -> NodeInfo | None:
+        return self._nodes.get(str(name))
+
+    def party_from_name(self, name: str) -> Party | None:
+        info = self._nodes.get(str(name))
+        return info.legal_identity if info else None
+
+    def notary_nodes(self) -> list[NodeInfo]:
+        return [n for n in self._nodes.values()
+                if any(s.type.startswith("corda.notary") for s in n.advertised_services)]
+
+    def all_nodes(self) -> list[NodeInfo]:
+        return list(self._nodes.values())
+
+
+class ServiceHub:
+    """The hub handed to flows (`flow.service_hub`) and services."""
+
+    def __init__(self, my_info: NodeInfo, network_service,
+                 key_pairs=(), verifier_service=None):
+        self.my_info = my_info
+        self.network_service = network_service
+        self.storage = TransactionStorage()
+        self.key_management = KeyManagementService(key_pairs)
+        self.identity_service = InMemoryIdentityService([my_info.legal_identity])
+        self.attachments = InMemoryAttachmentStorage()
+        self.network_map_cache = NetworkMapCache()
+        self.network_map_cache.add_node(my_info)
+        self.verifier_service = verifier_service
+        self.smm = None  # set by the node after SMM construction
+        from .vault import NodeVaultService
+        self.vault = NodeVaultService(self)
+
+    # -- identity / directory -----------------------------------------------
+    def well_known_party(self, name) -> Party | None:
+        return self.network_map_cache.party_from_name(name)
+
+    # -- state resolution (WireTransaction.toLedgerTransaction seam) ---------
+    def load_state(self, ref):
+        stx = self.storage.get_transaction(ref.txhash)
+        if stx is None:
+            return None
+        wtx = stx.tx if hasattr(stx, "tx") else stx
+        if ref.index >= len(wtx.outputs):
+            return None
+        return wtx.outputs[ref.index]
+
+    # -- ledger recording (ServiceHub.recordTransactions) --------------------
+    def record_transactions(self, *stxs) -> None:
+        # vault updates land before ledger-commit waiters wake, so a resumed
+        # flow observes a consistent vault (HibernateObserver ordering analog)
+        fresh = [stx for stx in stxs
+                 if self.storage.add_transaction(stx, notify=False)]
+        if fresh:
+            self.vault.notify_all(fresh)
+            for stx in fresh:
+                self.storage.notify_listeners(stx)
+
+    # -- signing -------------------------------------------------------------
+    def sign(self, content: bytes, key: PublicKey | None = None
+             ) -> DigitalSignatureWithKey:
+        key = key or self.my_info.legal_identity.owning_key
+        return self.key_management.sign(content, key)
+
+    def sign_initial_transaction(self, wtx, key: PublicKey | None = None):
+        from ..core.transactions.signed import SignedTransaction
+        key = key or self.my_info.legal_identity.owning_key
+        return SignedTransaction.of(wtx, [self.sign(wtx.id.bytes, key)])

@@ -1,0 +1,543 @@
+"""Flow state machine manager: sessions, suspension, checkpoint-by-replay.
+
+Reference parity (node/services/statemachine/):
+- StateMachineManager.add/onSessionMessage/onSessionInit
+  (StateMachineManager.kt:307-405, 504-524)
+- session message set ported semantically verbatim from SessionMessage.kt:14-41
+  (SessionInit/Confirm/Reject/Data/NormalSessionEnd/ErrorSessionEnd)
+- restore-and-resume (StateMachineManager.kt:257-305) — here via deterministic
+  replay of the checkpointed response log instead of Quasar deserialization
+  (design rationale: corda_tpu.flows docstring).
+
+Execution model: flows run cooperatively on the caller's thread until they
+block (the single-threaded AffinityExecutor discipline of the reference node,
+AbstractNode serverThread — and exactly MockNetwork's deterministic pumping).
+"""
+from __future__ import annotations
+
+import uuid
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any
+
+from ..core.serialization import deserialize, register_type, serialize
+from ..flows.api import (ExecuteOnce, FlowException, FlowLogic, FlowSession,
+                         Receive, Send, SendAndReceive, UntrustworthyData,
+                         WaitForLedgerCommit, flow_name,
+                         get_initiated_flow_factory)
+from ..network.messaging import TOPIC_P2P, TopicSession
+from .checkpoints import Checkpoint, CheckpointStorage, SessionSnapshot
+
+
+# ---------------------------------------------------------------------------
+# Session protocol wire messages (SessionMessage.kt:14-41)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SessionInit:
+    initiator_session_id: int
+    initiator_party: str
+    flow_name: str
+    first_payload: Any = None
+
+
+@dataclass(frozen=True)
+class SessionConfirm:
+    initiator_session_id: int
+    initiated_session_id: int
+
+
+@dataclass(frozen=True)
+class SessionReject:
+    initiator_session_id: int
+    error_message: str
+
+
+@dataclass(frozen=True)
+class SessionData:
+    recipient_session_id: int
+    payload: Any
+
+
+@dataclass(frozen=True)
+class NormalSessionEnd:
+    recipient_session_id: int
+
+
+@dataclass(frozen=True)
+class ErrorSessionEnd:
+    recipient_session_id: int
+    error_message: str
+
+
+for _cls in (SessionInit, SessionConfirm, SessionReject, SessionData,
+             NormalSessionEnd, ErrorSessionEnd):
+    register_type(f"session.{_cls.__name__}", _cls)
+
+
+# ---------------------------------------------------------------------------
+# Flow state machine
+# ---------------------------------------------------------------------------
+
+class FlowStateMachine:
+    """One running flow (FlowStateMachineImpl analog, no fibers)."""
+
+    def __init__(self, run_id: str, flow: FlowLogic, smm: "StateMachineManager"):
+        self.run_id = run_id
+        self.flow = flow
+        self.smm = smm
+        self.generator = None
+        self.response_log: list = []     # entries: (kind, value)
+        self.replay_queue: list = []     # prefix of response_log on restore
+        # (session group, peer name) -> session; group 0 = the top-level flow,
+        # each @initiating_flow sub-flow gets a deterministic fresh group
+        # (FlowLogic.sub_flow) — the reference's (FlowLogic, Party) keying.
+        self.sessions: dict[tuple[int, str], FlowSession] = {}
+        self.session_group_stack: list = [(0, flow_name(type(flow)))]
+        self.session_group_counter: int = 0
+        self.parked_on = None            # pending Receive/SendAndReceive/Wait
+        self.parked_group: int = 0       # session group active at park time
+        self.result_future: Future = Future()
+        self.done = False
+
+    @property
+    def current_group(self) -> tuple[int, str]:
+        return self.session_group_stack[-1]
+
+    @property
+    def replaying(self) -> bool:
+        return bool(self.replay_queue)
+
+    def __repr__(self):
+        return f"FlowStateMachine({self.run_id[:8]}, {type(self.flow).__name__})"
+
+
+class StateMachineManager:
+    def __init__(self, service_hub, checkpoint_storage: CheckpointStorage | None = None):
+        self.hub = service_hub
+        self.checkpoints = checkpoint_storage if checkpoint_storage is not None \
+            else CheckpointStorage()
+        self.flows: dict[str, FlowStateMachine] = {}
+        self._session_index: dict[int, tuple[FlowStateMachine, FlowSession]] = {}
+        self._commit_waiters: dict[Any, list[FlowStateMachine]] = {}
+        self.changes: list = []  # callbacks: (event, fsm) — RPC feed hook
+        # Node-LOCAL initiated-flow factories (a notary's service flows live
+        # only on the notary node); falls back to the global @initiated_by
+        # registry — AbstractNode.registerInitiatedFlows / installCoreFlows.
+        self.flow_factories: dict[str, Any] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        """Register the P2P handler and restore checkpointed flows
+        (StateMachineManager.kt:197-270)."""
+        self._p2p_registration = self.hub.network_service.add_message_handler(
+            TopicSession(TOPIC_P2P), self._on_message)
+        if hasattr(self.hub, "storage"):
+            self.hub.storage.add_commit_listener(self._on_tx_committed)
+        for cp in self.checkpoints.get_all_checkpoints():
+            self._restore(cp)
+
+    def stop(self) -> None:
+        """Detach from messaging (node shutdown; checkpoints remain for the
+        next start — the restart path of the reference SMM)."""
+        reg = getattr(self, "_p2p_registration", None)
+        if reg is not None:
+            self.hub.network_service.remove_message_handler(reg)
+            self._p2p_registration = None
+
+    def add(self, flow: FlowLogic) -> FlowStateMachine:
+        """Start a new top-level flow (StateMachineManager.kt:504-524)."""
+        fsm = FlowStateMachine(uuid.uuid4().hex, flow, self)
+        self._register(fsm)
+        self._notify("add", fsm)
+        self._start_generator(fsm)
+        self._advance(fsm, first=True)
+        return fsm
+
+    def _register(self, fsm: FlowStateMachine) -> None:
+        self.flows[fsm.run_id] = fsm
+        fsm.flow.state_machine = fsm
+        fsm.flow.service_hub = self.hub
+
+    def _start_generator(self, fsm: FlowStateMachine) -> None:
+        gen = fsm.flow.call()
+        if not hasattr(gen, "send"):
+            # plain function: completed synchronously with its return value
+            fsm.generator = None
+            self._complete(fsm, gen)
+            return
+        fsm.generator = gen
+
+    def _notify(self, event: str, fsm: FlowStateMachine) -> None:
+        for cb in list(self.changes):
+            cb(event, fsm)
+
+    # -- the drive loop ------------------------------------------------------
+    def _advance(self, fsm: FlowStateMachine, first: bool = False,
+                 resume_value: Any = None, resume_error: Exception | None = None
+                 ) -> None:
+        """Run the generator until it parks or finishes. Each iteration feeds
+        the previous response and receives the next FlowIORequest."""
+        if fsm.generator is None or fsm.done:
+            return
+        gen = fsm.generator
+        try:
+            if first:
+                request = next(gen)
+            elif resume_error is not None:
+                request = gen.throw(resume_error)
+            else:
+                request = gen.send(resume_value)
+        except StopIteration as stop:
+            self._complete(fsm, stop.value)
+            return
+        except Exception as e:
+            self._fail(fsm, e)
+            return
+
+        while True:
+            try:
+                if fsm.replaying:
+                    action = self._replay_step(fsm, request)
+                elif getattr(fsm, "restoring", False):
+                    # First live request after replay = the request the flow was
+                    # parked on when checkpointed. Its send side already ran
+                    # before the restart — only re-arm the wait side.
+                    fsm.restoring = False
+                    action = self._reexecute_parked(fsm, request)
+                else:
+                    action = self._execute_request(fsm, request)
+            except Exception as e:
+                self._fail(fsm, e)
+                return
+            if action is _PARK:
+                fsm.parked_on = request
+                fsm.parked_group = fsm.current_group[0]
+                self._checkpoint(fsm)
+                return
+            kind, value, error = action
+            try:
+                if error is not None:
+                    request = gen.throw(error)
+                else:
+                    request = gen.send(value)
+            except StopIteration as stop:
+                self._complete(fsm, stop.value)
+                return
+            except Exception as e:
+                self._fail(fsm, e)
+                return
+
+    def _resume(self, fsm: FlowStateMachine, value: Any = None,
+                error: Exception | None = None) -> None:
+        fsm.parked_on = None
+        self._advance(fsm, resume_value=value, resume_error=error)
+
+    # -- request execution ---------------------------------------------------
+    def _execute_request(self, fsm: FlowStateMachine, request):
+        if isinstance(request, Send):
+            self._do_send(fsm, request.party, request.payload)
+            return self._log(fsm, ("send", None))
+        if isinstance(request, SendAndReceive):
+            self._do_send(fsm, request.party, request.payload)
+            return self._try_receive(fsm, request.party)
+        if isinstance(request, Receive):
+            self._ensure_session(fsm, request.party, first_payload=None)
+            return self._try_receive(fsm, request.party)
+        if isinstance(request, WaitForLedgerCommit):
+            stx = self.hub.storage.get_transaction(request.tx_id)
+            if stx is not None:
+                return self._log(fsm, ("commit", request.tx_id))
+            self._commit_waiters.setdefault(request.tx_id, []).append(fsm)
+            return _PARK
+        if isinstance(request, ExecuteOnce):
+            return self._log(fsm, ("value", request.producer()))
+        raise TypeError(f"Flow yielded a non-request value: {request!r}")
+
+    def _log(self, fsm: FlowStateMachine, entry):
+        """Append to the response log and produce the resume action."""
+        fsm.response_log.append(entry)
+        kind, value = entry
+        if kind == "send":
+            return (kind, None, None)
+        if kind == "data":
+            return (kind, UntrustworthyData(value), None)
+        if kind == "value":
+            return (kind, value, None)
+        if kind == "commit":
+            return (kind, self.hub.storage.get_transaction(value), None)
+        if kind == "error":
+            return (kind, None, FlowException(value))
+        raise AssertionError(entry)
+
+    def _reexecute_parked(self, fsm: FlowStateMachine, request):
+        """Re-arm a request that was pending when the checkpoint was written:
+        receives re-check the (restored) inbound queue; ledger waits re-check
+        storage; sends never park so never appear here."""
+        if isinstance(request, (Receive, SendAndReceive)):
+            return self._try_receive(fsm, request.party)
+        return self._execute_request(fsm, request)
+
+    def _replay_step(self, fsm: FlowStateMachine, request):
+        """Consume one recorded response instead of performing IO
+        (restore-and-resume: the IO already happened before the restart)."""
+        entry = fsm.replay_queue.pop(0)
+        kind, value = entry
+        if kind == "send":
+            return (kind, None, None)
+        if kind == "data":
+            return (kind, UntrustworthyData(value), None)
+        if kind == "value":
+            return (kind, value, None)
+        if kind == "commit":
+            return (kind, self.hub.storage.get_transaction(value), None)
+        if kind == "error":
+            return (kind, None, FlowException(value))
+        raise AssertionError(entry)
+
+    def _try_receive(self, fsm: FlowStateMachine, party):
+        sess = fsm.sessions[(fsm.current_group[0], str(party.name))]
+        if sess.received:
+            payload = sess.received.pop(0)
+            return self._log(fsm, ("data", payload))
+        if sess.error is not None:
+            err, sess.error = sess.error, None
+            sess.state = "ended"  # the session is dead; later receives must
+            return self._log(fsm, ("error", str(err)))  # fail, not hang
+        if sess.state in ("ended", "errored"):
+            return self._log(fsm, ("error",
+                                   f"Session with {party.name} has ended"))
+        return _PARK
+
+    # -- session plumbing ----------------------------------------------------
+    def _ensure_session(self, fsm: FlowStateMachine, party,
+                        first_payload) -> FlowSession:
+        group, initiator_name = fsm.current_group
+        key = (group, str(party.name))
+        sess = fsm.sessions.get(key)
+        if sess is not None:
+            return sess
+        sess = FlowSession(peer=party)
+        sess.group = group
+        fsm.sessions[key] = sess
+        self._session_index[sess.our_session_id] = (fsm, sess)
+        init = SessionInit(sess.our_session_id,
+                           str(self.hub.my_info.legal_identity.name),
+                           initiator_name, first_payload)
+        self._post(party, init)
+        sess._init_payload_sent = first_payload is not None
+        return sess
+
+    def _do_send(self, fsm: FlowStateMachine, party, payload) -> None:
+        sess = fsm.sessions.get((fsm.current_group[0], str(party.name)))
+        if sess is None:
+            self._ensure_session(fsm, party, first_payload=payload)
+            return
+        if sess.state == "initiating":
+            if not hasattr(sess, "pending_out"):
+                sess.pending_out = []
+            sess.pending_out.append(payload)
+            return
+        if sess.state in ("ended", "errored"):
+            raise FlowException(f"Session with {party.name} is {sess.state}")
+        self._post(party, SessionData(sess.peer_session_id, payload))
+
+    def _post(self, party, message) -> None:
+        self.hub.network_service.send(
+            TopicSession(TOPIC_P2P), serialize(message), str(party.name))
+
+    # -- inbound dispatch (onSessionMessage, StateMachineManager.kt:307+) ----
+    def _on_message(self, msg) -> None:
+        sm = deserialize(msg.data)
+        if isinstance(sm, SessionInit):
+            self._on_session_init(sm)
+            return
+        if isinstance(sm, SessionConfirm):
+            entry = self._session_index.get(sm.initiator_session_id)
+            if entry is None:
+                return
+            fsm, sess = entry
+            sess.peer_session_id = sm.initiated_session_id
+            sess.state = "open"
+            for payload in getattr(sess, "pending_out", []):
+                self._post(sess.peer, SessionData(sess.peer_session_id, payload))
+            if hasattr(sess, "pending_out"):
+                sess.pending_out = []
+            return
+        entry = self._session_index.get(sm.recipient_session_id
+                                        if not isinstance(sm, SessionReject)
+                                        else sm.initiator_session_id)
+        if entry is None:
+            return
+        fsm, sess = entry
+        if isinstance(sm, SessionReject):
+            sess.state = "errored"
+            sess.error = FlowException(sm.error_message)
+        elif isinstance(sm, SessionData):
+            sess.received.append(sm.payload)
+        elif isinstance(sm, NormalSessionEnd):
+            sess.state = "ended"
+        elif isinstance(sm, ErrorSessionEnd):
+            sess.state = "errored"
+            sess.error = FlowException(sm.error_message)
+        self._maybe_deliver(fsm, sess)
+
+    def _maybe_deliver(self, fsm: FlowStateMachine, sess: FlowSession) -> None:
+        req = fsm.parked_on
+        if req is None or not isinstance(req, (Receive, SendAndReceive)):
+            return
+        if str(req.party.name) != str(sess.peer.name):
+            return
+        if fsm.parked_group != getattr(sess, "group", 0):
+            return  # data for a different sub-flow's session
+        if sess.received:
+            payload = sess.received.pop(0)
+            fsm.response_log.append(("data", payload))
+            self._resume(fsm, value=UntrustworthyData(payload))
+        elif sess.error is not None:
+            err, sess.error = sess.error, None
+            sess.state = "ended"
+            fsm.response_log.append(("error", str(err)))
+            self._resume(fsm, error=FlowException(str(err)))
+        elif sess.state == "ended":
+            msg = f"Session with {sess.peer.name} has ended"
+            fsm.response_log.append(("error", msg))
+            self._resume(fsm, error=FlowException(msg))
+
+    def register_flow_factory(self, initiator_name: str, factory) -> None:
+        self.flow_factories[initiator_name] = factory
+
+    def _on_session_init(self, init: SessionInit) -> None:
+        factory = (self.flow_factories.get(init.flow_name)
+                   or get_initiated_flow_factory(init.flow_name))
+        peer = self.hub.well_known_party(init.initiator_party)
+        if factory is None or peer is None:
+            reason = (f"No initiated flow registered for {init.flow_name}"
+                      if factory is None else
+                      f"Unknown party {init.initiator_party}")
+            if peer is not None:
+                self._post(peer, SessionReject(init.initiator_session_id, reason))
+            return
+        flow = factory(peer)
+        fsm = FlowStateMachine(uuid.uuid4().hex, flow, self)
+        self._register(fsm)
+        sess = FlowSession(peer=peer,
+                           peer_session_id=init.initiator_session_id,
+                           state="open")
+        sess.group = 0  # the responder's top-level session
+        fsm.sessions[(0, str(peer.name))] = sess
+        self._session_index[sess.our_session_id] = (fsm, sess)
+        if init.first_payload is not None:
+            sess.received.append(init.first_payload)
+        self._post(peer, SessionConfirm(init.initiator_session_id,
+                                        sess.our_session_id))
+        self._notify("add", fsm)
+        self._start_generator(fsm)
+        self._advance(fsm, first=True)
+
+    # -- ledger-commit wakeups ----------------------------------------------
+    def _on_tx_committed(self, stx) -> None:
+        for fsm in self._commit_waiters.pop(stx.id, []):
+            fsm.response_log.append(("commit", stx.id))
+            self._resume(fsm, value=stx)
+
+    # -- completion ----------------------------------------------------------
+    def _complete(self, fsm: FlowStateMachine, result) -> None:
+        fsm.done = True
+        self._end_sessions(fsm, error=None)
+        self.checkpoints.remove_checkpoint(fsm.run_id)
+        self.flows.pop(fsm.run_id, None)
+        self._cleanup_sessions(fsm)
+        fsm.result_future.set_result(result)
+        self._notify("remove", fsm)
+
+    def _fail(self, fsm: FlowStateMachine, error: Exception) -> None:
+        fsm.done = True
+        self._end_sessions(fsm, error=error)
+        self.checkpoints.remove_checkpoint(fsm.run_id)
+        self.flows.pop(fsm.run_id, None)
+        self._cleanup_sessions(fsm)
+        fsm.result_future.set_exception(error)
+        self._notify("remove", fsm)
+
+    def _end_sessions(self, fsm: FlowStateMachine, error) -> None:
+        for sess in fsm.sessions.values():
+            if sess.state not in ("open", "initiating") or sess.peer_session_id is None:
+                continue
+            if error is None:
+                self._post(sess.peer, NormalSessionEnd(sess.peer_session_id))
+            else:
+                self._post(sess.peer,
+                           ErrorSessionEnd(sess.peer_session_id, str(error)))
+
+    def _cleanup_sessions(self, fsm: FlowStateMachine) -> None:
+        for sess in fsm.sessions.values():
+            self._session_index.pop(sess.our_session_id, None)
+
+    # -- checkpointing -------------------------------------------------------
+    def _checkpoint(self, fsm: FlowStateMachine) -> None:
+        """Atomic checkpoint at suspension (updateCheckpoint,
+        StateMachineManager.kt:526-543)."""
+        fields = {k: v for k, v in vars(fsm.flow).items()
+                  if k not in ("state_machine", "service_hub")}
+        sessions = [SessionSnapshot(
+            peer_name=str(s.peer.name), our_session_id=s.our_session_id,
+            peer_session_id=s.peer_session_id, state=s.state,
+            received=list(s.received),
+            pending_out=list(getattr(s, "pending_out", [])),
+            group=getattr(s, "group", 0))
+            for s in fsm.sessions.values()]
+        cp = Checkpoint(run_id=fsm.run_id,
+                        flow_class=flow_name(type(fsm.flow)),
+                        flow_fields=fields,
+                        response_log=list(fsm.response_log),
+                        sessions=sessions)
+        self.checkpoints.add_checkpoint(cp)
+
+    def _restore(self, cp: Checkpoint) -> None:
+        """Rebuild a flow from its checkpoint and replay it to its suspension
+        point (restoreFibersFromCheckpoints semantics via replay)."""
+        cls = _import_flow_class(cp.flow_class)
+        flow = cls.__new__(cls)
+        for k, v in cp.flow_fields.items():
+            setattr(flow, k, v)
+        fsm = FlowStateMachine(cp.run_id, flow, self)
+        fsm.response_log = list(cp.response_log)
+        fsm.replay_queue = list(cp.response_log)
+        self._register(fsm)
+        for snap in cp.sessions:
+            peer = self.hub.well_known_party(snap.peer_name)
+            sess = FlowSession(peer=peer, our_session_id=snap.our_session_id,
+                               peer_session_id=snap.peer_session_id,
+                               state=snap.state, received=list(snap.received))
+            sess.pending_out = list(snap.pending_out)
+            sess.group = snap.group
+            fsm.sessions[(snap.group, snap.peer_name)] = sess
+            self._session_index[sess.our_session_id] = (fsm, sess)
+        fsm.restoring = True
+        self._notify("add", fsm)
+        self._start_generator(fsm)
+        self._advance(fsm, first=True)
+
+
+_PARK = object()
+
+
+def _import_flow_class(name: str) -> type:
+    import importlib
+
+    # flow_name() produces module.QualName where QualName may be dotted
+    parts = name.split(".")
+    for split in range(len(parts) - 1, 0, -1):
+        try:
+            mod = importlib.import_module(".".join(parts[:split]))
+        except ImportError:
+            continue
+        obj = mod
+        try:
+            for attr in parts[split:]:
+                obj = getattr(obj, attr)
+            return obj
+        except AttributeError:
+            continue
+    raise ImportError(f"Cannot resolve flow class {name!r}")

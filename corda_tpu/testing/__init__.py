@@ -2,5 +2,9 @@
 dummy contracts, mock services, the in-memory MockNetwork, ledger DSL and driver.
 """
 from .dummy import DummyContract, DummyState, DUMMY_NOTARY_NAME
+from .mocknetwork import MockNetwork, MockNode
+from .services import MockAttachmentStorage, MockIdentityService, MockServices
 
-__all__ = ["DummyContract", "DummyState", "DUMMY_NOTARY_NAME"]
+__all__ = ["DummyContract", "DummyState", "DUMMY_NOTARY_NAME",
+           "MockAttachmentStorage", "MockIdentityService", "MockServices",
+           "MockNetwork", "MockNode"]

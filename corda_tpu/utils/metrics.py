@@ -1,0 +1,114 @@
+"""Minimal metrics registry (codahale-style: meters, timers, gauges, counters).
+
+Reference parity: MonitoringService (services/api/MonitoringService.kt:11) and
+the named verification metrics of OutOfProcessTransactionVerifierService.kt:33-45
+("Verification.Duration/Success/Failure/InFlight"). Thread-safe; snapshot-able
+for export (the JMX analog is `snapshot()` → dict, consumable by any exporter).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class Meter:
+    """Monotone event counter with a rate since creation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._t0 = time.monotonic()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            self.count += n
+
+    def mean_rate(self) -> float:
+        dt = time.monotonic() - self._t0
+        return self.count / dt if dt > 0 else 0.0
+
+
+class Timer:
+    """Duration accumulator; use as a context manager."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.update(time.perf_counter() - self._start)
+        return False
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.max_s = max(self.max_s, seconds)
+
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class Counter:
+    """Up/down counter (the in-flight gauge analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self.value += n
+
+    def dec(self, n: int = 1) -> None:
+        self.inc(-n)
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls()
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as {type(m).__name__}")
+            return m
+
+    def meter(self, name: str) -> Meter:
+        return self._get(name, Meter)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str, fn) -> None:
+        with self._lock:
+            self._metrics[name] = fn
+
+    def snapshot(self) -> dict:
+        out = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Meter):
+                out[name] = {"count": m.count, "mean_rate": m.mean_rate()}
+            elif isinstance(m, Timer):
+                out[name] = {"count": m.count, "mean_s": m.mean_s(), "max_s": m.max_s}
+            elif isinstance(m, Counter):
+                out[name] = {"value": m.value}
+            else:
+                out[name] = {"value": m()}
+        return out

@@ -1,0 +1,16 @@
+"""Transaction verification services — the north-star seam.
+
+Reference parity: `TransactionVerifierService` (Services.kt:544-550, async
+`verify(ltx) → future`), `InMemoryTransactionVerifierService` (4-thread pool),
+and the out-of-process verifier fan-out (Verifier.kt, VerifierApi.kt) — here
+re-designed TPU-first: per-signature EC verification and Merkle hashing are
+batched across MANY transactions into device kernels; contract `verify()`
+bodies and coverage checks stay on host.
+"""
+from .batcher import SignatureBatcher  # noqa: F401
+from .service import (  # noqa: F401
+    InMemoryTransactionVerifierService,
+    TpuTransactionVerifierService,
+    TransactionVerifierService,
+    make_verifier_service,
+)

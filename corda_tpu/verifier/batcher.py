@@ -1,0 +1,150 @@
+"""Cross-transaction signature batching onto device kernels.
+
+The TPU answer to the reference's per-signature JCA calls inside
+`SignedTransaction.checkSignaturesAreValid` (SignedTransaction.kt:96-100 →
+Crypto.doVerify, Crypto.kt:473-496): many flows/transactions submit
+(key, signature, content) checks concurrently; a dispatcher thread drains
+them, buckets by scheme (mixed-scheme batches would diverge on device —
+BASELINE.md config 2), and runs ONE batched kernel per scheme bucket.
+
+Latency/throughput trade: a flush triggers at ``max_batch`` items or after
+``max_latency_s`` from the first queued item — the p50 @ batch=1 metric pulls
+against batch-size throughput (SURVEY.md §7 hard part 4).
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+from ..core.crypto import ecmath
+from ..core.crypto.keys import PublicKey, curve_for_scheme, sec1_decompress
+from ..core.crypto.schemes import (
+    ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256, EDDSA_ED25519_SHA512)
+from ..core.crypto.signatures import Crypto
+from ..utils.metrics import MetricRegistry
+
+_ED = EDDSA_ED25519_SHA512.scheme_number_id
+_K1 = ECDSA_SECP256K1_SHA256.scheme_number_id
+_R1 = ECDSA_SECP256R1_SHA256.scheme_number_id
+
+_BUCKETS = {_ED: "ed25519", _K1: "secp256k1", _R1: "secp256r1"}
+
+
+@dataclass
+class _Pending:
+    key: PublicKey
+    signature: bytes
+    content: bytes
+    future: Future = field(default_factory=Future)
+
+
+class SignatureBatcher:
+    """Accepts individual signature checks, returns Future[bool] verdicts,
+    dispatches device-batched kernels per scheme from a background thread."""
+
+    def __init__(self, max_batch: int = 512, max_latency_s: float = 0.005,
+                 metrics: MetricRegistry | None = None, use_device: bool = True):
+        self.max_batch = max_batch
+        self.max_latency_s = max_latency_s
+        self.metrics = metrics if metrics is not None else MetricRegistry()
+        self.use_device = use_device
+        self._lock = threading.Condition()
+        self._queues: dict[str, list[_Pending]] = {
+            "ed25519": [], "secp256k1": [], "secp256r1": [], "host": []}
+        self._closed = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="sig-batcher")
+        self._thread.start()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, key: PublicKey, signature: bytes, content: bytes
+               ) -> Future:
+        """Future resolves to bool (valid/invalid); malformed input → False,
+        matching the batch kernels' precheck semantics."""
+        p = _Pending(key, signature, content)
+        bucket = _BUCKETS.get(key.scheme.scheme_number_id, "host")
+        if not self.use_device:
+            bucket = "host"
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("SignatureBatcher is closed")
+            self._queues[bucket].append(p)
+            self.metrics.counter("SigBatcher.InFlight").inc()
+            self._lock.notify()
+        return p.future
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify()
+        self._thread.join(timeout=5)
+
+    # -- dispatcher ----------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._closed and not any(self._queues.values()):
+                    self._lock.wait()
+                if not any(self._queues.values()):
+                    if self._closed:
+                        return
+                    continue
+                # linger briefly to let a batch accumulate
+                if (max(len(q) for q in self._queues.values()) < self.max_batch
+                        and not self._closed):
+                    self._lock.wait(timeout=self.max_latency_s)
+                drained = {name: q[: self.max_batch]
+                           for name, q in self._queues.items() if q}
+                for name, items in drained.items():
+                    del self._queues[name][: len(items)]
+            for name, items in drained.items():
+                self._dispatch(name, items)
+
+    def _dispatch(self, bucket: str, items: list[_Pending]) -> None:
+        timer = self.metrics.timer(f"SigBatcher.{bucket}.Duration")
+        try:
+            with timer:
+                if bucket == "ed25519":
+                    verdicts = self._run_ed25519(items)
+                elif bucket in ("secp256k1", "secp256r1"):
+                    verdicts = self._run_ecdsa(bucket, items)
+                else:
+                    verdicts = []
+                    for p in items:
+                        try:
+                            verdicts.append(
+                                Crypto.is_valid(p.key, p.signature, p.content))
+                        except Exception:
+                            verdicts.append(False)
+        except Exception as e:  # batch-level failure → fail every member
+            for p in items:
+                if not p.future.done():
+                    p.future.set_exception(e)
+            self.metrics.meter("SigBatcher.BatchFailure").mark()
+            self.metrics.counter("SigBatcher.InFlight").dec(len(items))
+            return
+        for p, ok in zip(items, verdicts):
+            p.future.set_result(bool(ok))
+        self.metrics.meter("SigBatcher.Checked").mark(len(items))
+        self.metrics.counter("SigBatcher.InFlight").dec(len(items))
+
+    @staticmethod
+    def _run_ed25519(items: list[_Pending]):
+        from ..ops import ed25519 as ed_ops
+        return ed_ops.verify_batch(
+            [(p.key.encoded, p.signature, p.content) for p in items])
+
+    @staticmethod
+    def _run_ecdsa(bucket: str, items: list[_Pending]):
+        from ..ops import weierstrass as wc_ops
+        curve = ecmath.SECP256K1 if bucket == "secp256k1" else ecmath.SECP256R1
+        kitems = []
+        for p in items:
+            point = sec1_decompress(curve_for_scheme(p.key.scheme), p.key.encoded)
+            try:
+                r, s = ecmath.ecdsa_sig_from_der(p.signature)
+            except (ValueError, IndexError):
+                r, s = 0, 0  # fails the kernel's range precheck → False
+            kitems.append((point, p.content, r, s))
+        return wc_ops.verify_batch(curve, kitems)

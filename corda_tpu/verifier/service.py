@@ -1,0 +1,124 @@
+"""Async transaction-verification services behind one pluggable seam.
+
+Reference parity:
+- `TransactionVerifierService.verify(ltx) → ListenableFuture` (Services.kt:544-550)
+- `InMemoryTransactionVerifierService` — fixed 4-worker pool running
+  `transaction.verify()` (InMemoryTransactionVerifierService.kt:10-18)
+- `OutOfProcessTransactionVerifierService` metrics names
+  (OutOfProcessTransactionVerifierService.kt:33-45)
+
+TPU-first redesign: `TpuTransactionVerifierService` splits a transaction's
+verification into (a) per-signature EC checks → `SignatureBatcher` device
+kernels, batched ACROSS transactions; (b) signature-coverage / platform-rule /
+contract-code checks → host thread pool. The `VerifierType`-style selection
+seam (NodeConfiguration.kt:91-94) is `make_verifier_service`.
+"""
+from __future__ import annotations
+
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..core.crypto.signatures import SignatureException
+from ..utils.metrics import MetricRegistry
+from .batcher import SignatureBatcher
+
+
+class TransactionVerifierService:
+    """SPI: async verification of a resolved LedgerTransaction. Subclasses
+    share the metrics-instrumented submission path (the named metrics of
+    OutOfProcessTransactionVerifierService.kt:33-45)."""
+
+    metrics: MetricRegistry
+    _pool: ThreadPoolExecutor
+
+    def verify(self, ltx) -> Future:
+        return self._submit_instrumented(ltx.verify)
+
+    def _submit_instrumented(self, work_fn) -> Future:
+        self.metrics.counter("Verification.InFlight").inc()
+
+        def work():
+            with self.metrics.timer("Verification.Duration"):
+                try:
+                    result = work_fn()
+                    self.metrics.meter("Verification.Success").mark()
+                    return result
+                except Exception:
+                    self.metrics.meter("Verification.Failure").mark()
+                    raise
+                finally:
+                    self.metrics.counter("Verification.InFlight").dec()
+
+        return self._pool.submit(work)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class InMemoryTransactionVerifierService(TransactionVerifierService):
+    """Host thread-pool backend (InMemoryTransactionVerifierService.kt:10-18)."""
+
+    def __init__(self, workers: int = 4, metrics: MetricRegistry | None = None):
+        self.metrics = metrics if metrics is not None else MetricRegistry()
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="verifier")
+
+
+class TpuTransactionVerifierService(TransactionVerifierService):
+    """Device-batched backend: signatures on TPU, contract rules on host.
+
+    `verify(ltx)` keeps the reference SPI (contract/platform rules only — the
+    reference's callers have already checked signatures by the time an ltx
+    exists). `verify_signed(stx, services)` is the full TPU-accelerated path:
+    device-batched `check_signatures_are_valid` + coverage + resolution +
+    `ltx.verify()`, semantics of SignedTransaction.verify
+    (SignedTransaction.kt:174-178).
+    """
+
+    def __init__(self, workers: int = 4, batcher: SignatureBatcher | None = None,
+                 metrics: MetricRegistry | None = None):
+        self.metrics = metrics if metrics is not None else MetricRegistry()
+        self.batcher = batcher if batcher is not None else SignatureBatcher(
+            metrics=self.metrics)
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="tpu-verifier")
+
+    # -- full TPU path (verify(ltx) is inherited) ----------------------------
+    def verify_signed(self, stx, services,
+                      check_sufficient_signatures: bool = True) -> Future:
+        """Async full verify of a SignedTransaction; the per-signature EC math
+        rides the shared device batcher (cross-transaction batching)."""
+        sig_futures = [
+            (sig, self.batcher.submit(sig.by, sig.bytes, stx.id.bytes))
+            for sig in stx.sigs]
+
+        def work():
+            for sig, fut in sig_futures:
+                if not fut.result():
+                    raise SignatureException(
+                        f"Signature by {sig.by.to_string_short()} did "
+                        f"not verify on transaction {stx.id.prefix_chars()}")
+            if check_sufficient_signatures:
+                missing = stx.get_missing_signatures()
+                if missing:
+                    from ..core.transactions.signed import (
+                        SignaturesMissingException)
+                    raise SignaturesMissingException(
+                        missing, [k.to_string_short() for k in missing], stx.id)
+            stx.to_ledger_transaction(services).verify()
+
+        return self._submit_instrumented(work)
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        self.batcher.close()
+
+
+def make_verifier_service(verifier_type: str = "InMemory", **kwargs
+                          ) -> TransactionVerifierService:
+    """The VerifierType config seam (NodeConfiguration.kt:91-94):
+    "InMemory" | "Tpu" ("OutOfProcess" arrives with the messaging layer)."""
+    if verifier_type == "InMemory":
+        return InMemoryTransactionVerifierService(**kwargs)
+    if verifier_type == "Tpu":
+        return TpuTransactionVerifierService(**kwargs)
+    raise ValueError(f"Unknown verifier type: {verifier_type}")

@@ -1,0 +1,124 @@
+"""Cash contract + flows tests.
+
+Reference analogs: CashTests.kt (clause conservation rules) and the cash flow
+tests (CashIssueFlowTests / CashPaymentFlowTests / CashExitFlowTests).
+"""
+import pytest
+
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.core.contracts.structures import Issued, PartyAndReference
+from corda_tpu.core.contracts.exceptions import TransactionVerificationException
+from corda_tpu.finance import (Cash, CashExitFlow, CashIssueFlow,
+                               CashPaymentFlow, CashState)
+from corda_tpu.flows import FlowException
+from corda_tpu.testing import MockNetwork
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    bank = network.create_node("O=Bank of Corda, L=London, C=GB")
+    alice = network.create_node("O=Alice, L=Madrid, C=ES")
+    network.start_nodes()
+    return network, notary, bank, alice
+
+
+def dollars(n):
+    return Amount(n * 100, USD)  # cents
+
+
+def test_issue_and_pay(net):
+    network, notary, bank, alice = net
+    fsm = bank.start_flow(CashIssueFlow(dollars(100), b"\x01", bank.party,
+                                        notary.party))
+    network.run_network()
+    stx = fsm.result_future.result(timeout=1)
+    issued = stx.tx.outputs[0].data
+    assert isinstance(issued, CashState)
+    assert issued.amount.quantity == 100 * 100
+    assert bank.services.vault.unconsumed_states(CashState)
+
+    # bank pays alice $30; change returns to bank
+    fsm = bank.start_flow(CashPaymentFlow(dollars(30), alice.party))
+    network.run_network()
+    pay_stx = fsm.result_future.result(timeout=1)
+    amounts = sorted(o.data.amount.quantity for o in pay_stx.tx.outputs)
+    assert amounts == [30 * 100, 70 * 100]
+    # alice's vault tracked her new cash
+    alice_states = alice.services.vault.unconsumed_states(CashState)
+    assert [s.state.data.amount.quantity for s in alice_states] == [30 * 100]
+    # bank's spent coin is consumed, change unconsumed
+    bank_states = bank.services.vault.unconsumed_states(CashState)
+    assert [s.state.data.amount.quantity for s in bank_states] == [70 * 100]
+    # double payment larger than balance fails cleanly
+    fsm = bank.start_flow(CashPaymentFlow(dollars(75), alice.party))
+    network.run_network()
+    with pytest.raises(FlowException, match="Insufficient cash"):
+        fsm.result_future.result(timeout=1)
+
+
+def test_exit(net):
+    network, notary, bank, alice = net
+    bank.start_flow(CashIssueFlow(dollars(50), b"\x01", bank.party,
+                                  notary.party))
+    network.run_network()
+    fsm = bank.start_flow(CashExitFlow(dollars(20), b"\x01"))
+    network.run_network()
+    stx = fsm.result_future.result(timeout=1)
+    remaining = bank.services.vault.unconsumed_states(CashState)
+    assert sum(s.state.data.amount.quantity for s in remaining) == 30 * 100
+
+
+def test_cash_contract_conservation():
+    """Direct contract-level checks (CashTests.kt style) without a network."""
+    from corda_tpu.core.crypto import generate_keypair
+    from corda_tpu.core.identity import Party
+    from corda_tpu.core.transactions.ledger import TransactionForContract
+    from corda_tpu.core.contracts.structures import AuthenticatedObject
+    from corda_tpu.core.crypto.secure_hash import SecureHash
+
+    bank_kp = generate_keypair(entropy=b"\x31" * 32)
+    bank = Party("O=Bank, L=London, C=GB", bank_kp.public)
+    alice_kp = generate_keypair(entropy=b"\x32" * 32)
+    token = Issued(PartyAndReference(bank, b"\x01"), USD)
+
+    def ctx(inputs, outputs, commands):
+        return TransactionForContract(
+            inputs=tuple(inputs), outputs=tuple(outputs), attachments=(),
+            commands=tuple(commands), id=SecureHash.sha256(b"tx"),
+            notary=None)
+
+    cash = Cash()
+    in_state = CashState(Amount(1000, token), bank_kp.public)
+    out_state = CashState(Amount(1000, token), alice_kp.public)
+    move = AuthenticatedObject((bank_kp.public,), (), Cash.Move())
+
+    # conserved move passes
+    cash.verify(ctx([in_state], [out_state], [move]))
+
+    # non-conserved move fails
+    bad_out = CashState(Amount(900, token), alice_kp.public)
+    with pytest.raises(TransactionVerificationException, match="conserved"):
+        cash.verify(ctx([in_state], [bad_out], [move]))
+
+    # move without the owner's signature fails
+    unsigned = AuthenticatedObject((alice_kp.public,), (), Cash.Move())
+    with pytest.raises(TransactionVerificationException, match="owner"):
+        cash.verify(ctx([in_state], [out_state], [unsigned]))
+
+    # issue must be signed by the issuer
+    issue_ok = AuthenticatedObject((bank_kp.public,), (), Cash.Issue())
+    cash.verify(ctx([], [in_state], [issue_ok]))
+    issue_bad = AuthenticatedObject((alice_kp.public,), (), Cash.Issue())
+    with pytest.raises(TransactionVerificationException, match="issuer"):
+        cash.verify(ctx([], [in_state], [issue_bad]))
+
+    # exit-only transactions must also conserve: no minting via bare Exit
+    exit_100 = AuthenticatedObject((bank_kp.public,), (),
+                                   Cash.Exit(Amount(100, token)))
+    out_900 = CashState(Amount(900, token), alice_kp.public)
+    cash.verify(ctx([in_state], [out_900], [exit_100]))  # 1000 = 900 + 100 ok
+    small_in = CashState(Amount(100, token), bank_kp.public)
+    with pytest.raises(TransactionVerificationException, match="conserved"):
+        cash.verify(ctx([small_in], [out_900], [exit_100]))  # mints 900

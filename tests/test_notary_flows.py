@@ -1,0 +1,100 @@
+"""End-to-end ledger flows over MockNetwork: finality, notarisation,
+double-spend rejection, dependency resolution, signature collection.
+
+Reference analogs: NotaryServiceTests / NotaryFlow tests, FinalityFlow usage
+in TwoPartyTradeFlowTests, ResolveTransactionsFlowTest, CollectSignaturesFlowTests.
+"""
+import pytest
+
+from corda_tpu.core.contracts import Command, StateAndRef, StateRef
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.flows import FlowException
+from corda_tpu.flows.library import (CollectSignaturesFlow, FinalityFlow,
+                                     NotaryException, NotaryFlow,
+                                     SignTransactionFlow, install_core_flows)
+from corda_tpu.flows.api import flow_name
+from corda_tpu.testing import DummyContract, DummyState, MockNetwork
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    alice = network.create_node("O=Alice, L=London, C=GB")
+    bob = network.create_node("O=Bob, L=Paris, C=FR")
+    network.start_nodes()
+    return network, notary, alice, bob
+
+
+def issue_state(network, node, notary, magic=1):
+    """Self-issue a DummyState and finalise it (no inputs → no notarisation)."""
+    builder = TransactionBuilder(notary=notary.party)
+    builder.add_output_state(DummyState(magic, (node.party.owning_key,)))
+    builder.add_command(DummyContract.Create(), node.party.owning_key)
+    wtx = builder.to_wire_transaction()
+    stx = node.services.sign_initial_transaction(wtx)
+    fsm = node.start_flow(FinalityFlow(stx))
+    network.run_network()
+    final = fsm.result_future.result(timeout=1)
+    return final, StateAndRef(final.tx.outputs[0], StateRef(final.id, 0))
+
+
+def move_state(node, state_and_ref, new_owner_key):
+    builder = TransactionBuilder()
+    builder.add_input_state(state_and_ref)
+    builder.add_output_state(DummyState(
+        state_and_ref.state.data.magic_number, (new_owner_key,)))
+    builder.add_command(DummyContract.Move(), node.party.owning_key)
+    wtx = builder.to_wire_transaction()
+    return node.services.sign_initial_transaction(wtx)
+
+
+def test_issue_and_notarised_move(net):
+    network, notary, alice, bob = net
+    _, sref = issue_state(network, alice, notary)
+    stx = move_state(alice, sref, bob.party.owning_key)
+    fsm = alice.start_flow(FinalityFlow(stx))
+    network.run_network()
+    final = fsm.result_future.result(timeout=1)
+    # notary signature attached
+    assert any(s.by == notary.party.owning_key for s in final.sigs)
+    final.verify_signatures()
+    # Bob resolved the dependency chain and recorded both transactions
+    assert bob.services.storage.get_transaction(final.id) is not None
+    assert bob.services.storage.get_transaction(sref.ref.txhash) is not None
+
+
+def test_double_spend_rejected(net):
+    network, notary, alice, bob = net
+    _, sref = issue_state(network, alice, notary)
+    stx1 = move_state(alice, sref, bob.party.owning_key)
+    fsm1 = alice.start_flow(FinalityFlow(stx1))
+    network.run_network()
+    fsm1.result_future.result(timeout=1)
+
+    stx2 = move_state(alice, sref, alice.party.owning_key)
+    fsm2 = alice.start_flow(NotaryFlow(stx2))
+    network.run_network()
+    with pytest.raises(NotaryException, match="already consumed"):
+        fsm2.result_future.result(timeout=1)
+
+
+def test_collect_signatures(net):
+    network, notary, alice, bob = net
+    # a transaction requiring BOTH alice's and bob's signatures
+    builder = TransactionBuilder(notary=notary.party)
+    builder.add_output_state(DummyState(
+        5, (alice.party.owning_key, bob.party.owning_key)))
+    builder.add_command(DummyContract.Create(),
+                       alice.party.owning_key, bob.party.owning_key)
+    wtx = builder.to_wire_transaction()
+    stx = alice.services.sign_initial_transaction(wtx)
+    # bob auto-signs (register the abstract responder with no extra checks)
+    bob.smm.register_flow_factory(flow_name(CollectSignaturesFlow),
+                                  SignTransactionFlow)
+    fsm = alice.start_flow(CollectSignaturesFlow(stx))
+    network.run_network()
+    full = fsm.result_future.result(timeout=1)
+    assert {s.by for s in full.sigs} == {alice.party.owning_key,
+                                         bob.party.owning_key}
+    full.verify_signatures()

@@ -1,0 +1,103 @@
+"""RPC ops surface + network map service tests.
+
+Reference analogs: CordaRPCOpsImpl tests, NetworkMapServiceTest (registration,
+fetch, subscribe-push).
+"""
+import pytest
+
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.finance import CashIssueFlow, CashState
+from corda_tpu.network.netmap import NetworkMapClient, NetworkMapService
+from corda_tpu.node.rpc import CordaRPCOps, FlowPermissionException
+from corda_tpu.testing import MockNetwork
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    bank = network.create_node("O=Bank, L=London, C=GB")
+    network.start_nodes()
+    return network, notary, bank
+
+
+def test_rpc_start_flow_and_feeds(net):
+    network, notary, bank = net
+    rpc = CordaRPCOps(bank.services, bank.smm)
+    assert "CashIssueFlow" in str(rpc.registered_flows())
+    events = []
+    rpc.state_machines_feed().subscribe(events.append)
+    vault_updates = []
+    rpc.vault_feed().subscribe(vault_updates.append)
+
+    fsm = rpc.start_flow_dynamic("CashIssueFlow", Amount(5000, USD), b"\x01",
+                                 bank.party, notary.party)
+    network.run_network()
+    fsm.result_future.result(timeout=1)
+    assert [s.state.data.amount.quantity for s in rpc.vault_snapshot(CashState)] \
+        == [5000]
+    assert rpc.verified_transactions_snapshot()
+    assert any(e[0] == "add" for e in events)
+    assert any(e[0] == "remove" for e in events)
+    assert vault_updates and vault_updates[0].produced
+
+    with pytest.raises(FlowPermissionException):
+        rpc.start_flow_dynamic("NotAFlow")
+    # a flow class without @StartableByRPC is refused
+    from corda_tpu.flows.library import NotaryFlow
+    with pytest.raises(FlowPermissionException):
+        rpc.start_flow_dynamic(NotaryFlow, None)
+
+    assert rpc.notary_identities() == [notary.party]
+    assert rpc.parties_from_name("Bank") == {bank.party}
+    att_id = rpc.upload_attachment(b"some jar bytes")
+    assert rpc.attachment_exists(att_id)
+    assert rpc.open_attachment(att_id).data == b"some jar bytes"
+
+
+def test_network_map_register_fetch_push():
+    network = MockNetwork()
+    mapnode = network.create_node("O=Map Service, L=London, C=GB")
+    a = network.create_node("O=Alpha, L=Oslo, C=NO")
+    b = network.create_node("O=Beta, L=Rome, C=IT")
+    network.start_nodes()
+    NetworkMapService(mapnode.messaging)
+    map_name = str(mapnode.party.name)
+
+    # Alpha registers, Beta subscribes then fetches: Beta learns Alpha
+    b.services.network_map_cache.remove_node(str(a.party.name))
+    a_client = NetworkMapClient(a.services, map_name)
+    b_client = NetworkMapClient(b.services, map_name)
+    b_client.subscribe()
+    network.run_network()
+    a_client.register()
+    network.run_network()
+    assert b.services.network_map_cache.party_from_name(str(a.party.name)) \
+        == a.party
+
+    # fetch-from-scratch also works
+    b.services.network_map_cache.remove_node(str(a.party.name))
+    b_client.fetch()
+    network.run_network()
+    assert b.services.network_map_cache.party_from_name(str(a.party.name)) \
+        == a.party
+
+    # a forged registration (wrong signer) is ignored
+    from corda_tpu.network.netmap import NodeRegistration, ADD
+    from corda_tpu.core.serialization import serialize
+    forged_info = serialize(a.info)
+    sig = b.services.key_management.sign(
+        forged_info + bytes([9]), b.party.owning_key)
+    forged = NodeRegistration(forged_info, 9, ADD, sig)
+    from corda_tpu.network.messaging import (TOPIC_NETWORK_MAP_REGISTER,
+                                             TopicSession)
+    b.messaging.send(TopicSession(TOPIC_NETWORK_MAP_REGISTER),
+                     serialize(forged), map_name)
+    network.run_network()
+    # serial 9 must NOT have been accepted for Alpha (signature by Beta's key)
+    # → a re-fetch still returns Alpha's original serial-1 registration
+    b.services.network_map_cache.remove_node(str(a.party.name))
+    b_client.fetch()
+    network.run_network()
+    assert b.services.network_map_cache.party_from_name(str(a.party.name)) \
+        == a.party
