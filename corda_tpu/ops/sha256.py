@@ -45,23 +45,40 @@ def _rotr(x, n: int):
 
 
 def compress(state, block):
-    """One SHA-256 compression: ``state`` (..., 8) u32, ``block`` (..., 16) u32."""
-    w = [block[..., i] for i in range(16)]
-    for t in range(16, 64):
-        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> 3)
-        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> 10)
-        w.append(w[t - 16] + s0 + w[t - 7] + s1)
-    a, b, c, d, e, f, g, h = (state[..., i] for i in range(8))
-    for t in range(64):
+    """One SHA-256 compression: ``state`` (..., 8) u32, ``block`` (..., 16) u32.
+
+    Both the message schedule (48 steps over a rolling 16-word window) and the
+    64 rounds are `lax.scan`s, so the XLA graph is one-step-sized instead of a
+    64x-unrolled block — compile time drops from minutes to seconds on the
+    deep Merkle kernels, and the batch axis supplies all the parallelism the
+    VPU needs. (`unroll=` on the scans is the knob if a profile ever favors
+    partial unrolling on real hardware.)"""
+    w_init = jnp.moveaxis(block, -1, 0)  # (16, ...)
+
+    def sched(window, _):
+        wm16, wm15, wm7, wm2 = window[0], window[1], window[9], window[14]
+        s0 = _rotr(wm15, 7) ^ _rotr(wm15, 18) ^ (wm15 >> 3)
+        s1 = _rotr(wm2, 17) ^ _rotr(wm2, 19) ^ (wm2 >> 10)
+        nw = wm16 + s0 + wm7 + s1
+        return jnp.concatenate([window[1:], nw[None]], axis=0), nw
+
+    _, w_rest = jax.lax.scan(sched, w_init, None, length=48)
+    ws = jnp.concatenate([w_init, w_rest], axis=0)  # (64, ...)
+
+    def round_fn(carry, wk):
+        a, b, c, d, e, f, g, h = carry
+        w_t, k_t = wk
         s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + jnp.uint32(_K[t]) + w[t]
+        t1 = h + s1 + ch + k_t + w_t
         s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = s0 + maj
-        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
-    out = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
-    return state + out
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[..., i] for i in range(8))
+    final, _ = jax.lax.scan(round_fn, init, (ws, jnp.asarray(_K)))
+    return state + jnp.stack(final, axis=-1)
 
 
 @jax.jit
@@ -97,12 +114,19 @@ def hash_pairs(pairs) -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=("n",))
 def _merkle_root_impl(leaves, n: int):
-    level = leaves
-    while n > 1:
-        pairs = level.reshape(level.shape[:-2] + (n // 2, 16))
-        level = hash_pairs(pairs)
-        n //= 2
-    return level[..., 0, :]
+    """Tree-hash via a fori_loop over levels on a fixed-width buffer: every
+    iteration hashes all n/2 adjacent pairs (lanes beyond the live level are
+    garbage and ignored), so ONE compiled level body serves every tree depth
+    instead of a depth-unrolled graph per leaf count."""
+    levels = n.bit_length() - 1  # log2(n)
+
+    def level_step(_, buf):
+        pairs = buf.reshape(buf.shape[:-2] + (n // 2, 16))
+        hashed = hash_pairs(pairs)
+        return jnp.concatenate([hashed, jnp.zeros_like(hashed)], axis=-2)
+
+    buf = jax.lax.fori_loop(0, levels, level_step, leaves)
+    return buf[..., 0, :]
 
 
 def merkle_root(leaves) -> jax.Array:

@@ -1,0 +1,191 @@
+"""Batched ECDSA verification over short-Weierstrass curves on device.
+
+Covers the reference's ECDSA_SECP256K1_SHA256 and ECDSA_SECP256R1_SHA256
+schemes (reference Crypto.kt:91,105; verify dispatch Crypto.kt:473-496 via
+BouncyCastle). TPU-first design notes:
+
+- Projective (X:Y:Z) coordinates with the *complete* addition law of
+  Renes–Costello–Batina (EuroCrypt 2016, "Complete addition formulas for
+  prime order elliptic curves", Algorithm 1, arbitrary a, b3 = 3b). Complete
+  ⇒ identity/doubling/inverse edge cases all take the same straight-line
+  code — no data-dependent branches, exactly what SIMD batching and XLA
+  tracing want. Both NIST-style (a=-3) and secp256k1 (a=0) run through the
+  same kernel with different curve constants.
+- Scalars/bit ladders and field limbs as in ops/field.py; `lax.scan` keeps
+  graphs one-iteration-sized.
+
+ECDSA verify (SEC 1 v2 §4.1.4): with e = H(m) as int, w = s⁻¹ mod n,
+u1 = e·w, u2 = r·w (host, cheap), accept iff X = [u1]G + [u2]Q ≠ ∞ and
+x(X) ≡ r (mod n). The final affine conversion is a device Fermat inversion;
+x ≡ r (mod n) is checked as x == r or x == r + n (only candidates with
+x < p, r < n < p), with the r+n candidate host-validated.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.crypto.ecmath import SECP256K1, SECP256R1, WeierstrassCurve, _bits2int
+from . import field as F
+
+CURVES = {"secp256k1": SECP256K1, "secp256r1": SECP256R1}
+
+
+def _const(v: int, p: int) -> jnp.ndarray:
+    return jnp.asarray(F.to_limbs(v % p))
+
+
+def identity(shape) -> tuple:
+    """Projective identity (0 : 1 : 0)."""
+    z = jnp.zeros(shape + (F.NLIMB,), dtype=jnp.uint64)
+    return (z, z.at[..., 0].set(1), z)
+
+
+def add(Pt, Qt, curve: WeierstrassCurve):
+    """RCB16 Algorithm 1: complete projective addition, arbitrary a."""
+    p = curve.p
+    a_c = _const(curve.a, p)
+    b3_c = _const(3 * curve.b, p)
+    X1, Y1, Z1 = Pt
+    X2, Y2, Z2 = Qt
+    t0 = F.mul(X1, X2, p)
+    t1 = F.mul(Y1, Y2, p)
+    t2 = F.mul(Z1, Z2, p)
+    t3 = F.add(X1, Y1, p)
+    t4 = F.add(X2, Y2, p)
+    t3 = F.mul(t3, t4, p)
+    t4 = F.add(t0, t1, p)
+    t3 = F.sub(t3, t4, p)
+    t4 = F.add(X1, Z1, p)
+    t5 = F.add(X2, Z2, p)
+    t4 = F.mul(t4, t5, p)
+    t5 = F.add(t0, t2, p)
+    t4 = F.sub(t4, t5, p)
+    t5 = F.add(Y1, Z1, p)
+    X3 = F.add(Y2, Z2, p)
+    t5 = F.mul(t5, X3, p)
+    X3 = F.add(t1, t2, p)
+    t5 = F.sub(t5, X3, p)
+    Z3 = F.mul(a_c, t4, p)
+    X3 = F.mul(b3_c, t2, p)
+    Z3 = F.add(X3, Z3, p)
+    X3 = F.sub(t1, Z3, p)
+    Z3 = F.add(t1, Z3, p)
+    Y3 = F.mul(X3, Z3, p)
+    t1 = F.add(t0, t0, p)
+    t1 = F.add(t1, t0, p)
+    t2 = F.mul(a_c, t2, p)
+    t4 = F.mul(b3_c, t4, p)
+    t1 = F.add(t1, t2, p)
+    t2 = F.sub(t0, t2, p)
+    t2 = F.mul(a_c, t2, p)
+    t4 = F.add(t4, t2, p)
+    t0 = F.mul(t1, t4, p)
+    Y3 = F.add(Y3, t0, p)
+    t0 = F.mul(t5, t4, p)
+    X3 = F.mul(t3, X3, p)
+    X3 = F.sub(X3, t0, p)
+    t0 = F.mul(t3, t1, p)
+    Z3 = F.mul(t5, Z3, p)
+    Z3 = F.add(Z3, t0, p)
+    return (X3, Y3, Z3)
+
+
+def shamir_ladder(bits1, bits2, P1, P2, curve: WeierstrassCurve):
+    """[k1]P1 + [k2]P2: interleaved double-and-add over complete additions
+    (doubling reuses the complete add — valid for all inputs)."""
+    batch_shape = P1[0].shape[:-1]
+    P3 = add(P1, P2, curve)
+    Pid = identity(batch_shape)
+
+    def step(acc, bits):
+        b1, b2 = bits
+        acc = add(acc, acc, curve)
+        idx = b1 + 2 * b2
+        sel = lambda c0, c1, c2, c3: F.select(
+            idx == 3, c3, F.select(idx == 2, c2, F.select(idx == 1, c1, c0)))
+        addend = tuple(sel(*cs) for cs in zip(Pid, P1, P2, P3))
+        return add(acc, addend, curve), None
+
+    acc, _ = jax.lax.scan(step, Pid, (bits1.astype(jnp.uint64),
+                                      bits2.astype(jnp.uint64)))
+    return acc
+
+
+def verify_core(u1_bits, u2_bits, q_pts, r_cands, curve_name: str):
+    """Device core: X = [u1]G + [u2]Q; ok = Z≠0 ∧ x(X) ∈ {r, r+n} candidates.
+
+    r_cands: (2, B, 16) — limb encodings of r and (r+n if r+n<p else r).
+    Unjitted and shape-polymorphic so multi-chip callers can wrap it in
+    ``shard_map`` over a batch-sharded mesh (corda_tpu.parallel).
+    """
+    curve = CURVES[curve_name]
+    p = curve.p
+    batch_shape = q_pts[0].shape[:-1]
+    base = tuple(jnp.broadcast_to(_const(v, p), batch_shape + (F.NLIMB,))
+                 for v in (curve.gx, curve.gy, 1))
+    X, Y, Z = shamir_ladder(u1_bits, u2_bits, base, q_pts, curve)
+    nonzero = ~F.is_zero(Z, p)
+    # Affine x without division-by-zero hazard: Z=0 items are masked anyway,
+    # but inv(0)=0^(p-2)=0 keeps the lane well-defined.
+    x_aff = F.mul(X, F.inv(Z, p), p)
+    ok_r = F.eq(x_aff, r_cands[0], p) | F.eq(x_aff, r_cands[1], p)
+    return nonzero & ok_r
+
+
+_verify_kernel = jax.jit(verify_core, static_argnames=("curve_name",))
+
+
+def prepare_batch(curve: WeierstrassCurve,
+                  items: list[tuple[tuple[int, int] | None, bytes, int, int]]):
+    """Host prep: (pub_point, message, r, s) → kernel inputs + precheck mask.
+
+    Structural checks mirror the host oracle ecmath.ecdsa_verify (low-s rule
+    included). Message hashing (SHA-256) stays host-side here; bulk Merkle
+    hashing is the device path in ops/sha256.py.
+    """
+    n_items = len(items)
+    precheck = np.ones(n_items, dtype=bool)
+    q_pts, u1s, u2s, r0, r1 = [], [], [], [], []
+    for i, (pub, msg, r, s) in enumerate(items):
+        ok = (1 <= r < curve.n and 1 <= s <= curve.n // 2
+              and pub is not None and curve.is_on_curve(pub))
+        if ok:
+            e = _bits2int(hashlib.sha256(msg).digest(), curve.n) % curve.n
+            w = pow(s, curve.n - 2, curve.n)
+            u1, u2 = e * w % curve.n, r * w % curve.n
+        if not ok:
+            precheck[i] = False
+            pub, u1, u2, r = curve.g, 0, 0, 0
+        q_pts.append(pub)
+        u1s.append(u1)
+        u2s.append(u2)
+        r0.append(r)
+        r1.append(r + curve.n if r + curve.n < curve.p else r)
+    qx = jnp.asarray(F.to_limbs([q[0] for q in q_pts]))
+    qy = jnp.asarray(F.to_limbs([q[1] for q in q_pts]))
+    qz = jnp.zeros_like(qx).at[..., 0].set(1)
+    r_cands = jnp.asarray(np.stack([F.to_limbs(r0), F.to_limbs(r1)]))
+    u1_bits = jnp.asarray(F.scalars_to_bits(u1s))
+    u2_bits = jnp.asarray(F.scalars_to_bits(u2s))
+    return u1_bits, u2_bits, (qx, qy, qz), r_cands, precheck
+
+
+
+def verify_batch(curve: WeierstrassCurve,
+                 items: list[tuple[tuple[int, int] | None, bytes, int, int]]
+                 ) -> np.ndarray:
+    """Batched ECDSA verify: [(pub_affine, msg, r, s)] → bool verdicts (B,).
+
+    Pads to a power-of-two bucket (replicating the last item) so the device
+    kernel compiles once per bucket size."""
+    n = len(items)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    padded = items + [items[-1]] * (F.bucket_size(n) - n)
+    u1_bits, u2_bits, q_pts, r_cands, precheck = prepare_batch(curve, padded)
+    ok = np.asarray(_verify_kernel(u1_bits, u2_bits, q_pts, r_cands, curve.name))
+    return (ok & precheck)[:n]

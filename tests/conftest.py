@@ -6,7 +6,22 @@ sharding without real chips by asking XLA for 8 host-platform devices.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment may pre-register a hardware TPU backend from sitecustomize
+# *before* this file runs, so env-var platform selection (JAX_PLATFORMS) is too
+# late; jax.config.update after import is the reliable override. Without it the
+# suite eagerly dispatches every op over the TPU tunnel (~20x slower than CPU).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: the curve-kernel scans cost tens of seconds
+# to compile; caching makes repeated suite runs (and CI re-runs) near-instant.
+import pathlib
+
+jax.config.update("jax_compilation_cache_dir",
+                  str(pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,155 @@
+"""Differential tests: device curve kernels vs the pure-Python host oracle.
+
+Mirrors the reference's crypto unit tests (core/src/test/.../crypto/
+CryptoUtilsTest: sign/verify roundtrip + malformed-input rejection per
+scheme) as the bit-exactness oracle for the TPU kernels (SURVEY.md §4.1).
+"""
+import hashlib
+
+import numpy as np
+import pytest
+
+from corda_tpu.core.crypto import ecmath
+from corda_tpu.ops import ed25519 as ed_ops
+from corda_tpu.ops import field as F
+from corda_tpu.ops import weierstrass as wc_ops
+
+RNG = np.random.default_rng(7)
+
+
+def rand_scalar(n):
+    return int.from_bytes(RNG.bytes(32), "little") % n
+
+
+# ---------------------------------------------------------------------------
+# Ed25519
+# ---------------------------------------------------------------------------
+
+def ed_rand_points(k):
+    pts = []
+    for _ in range(k):
+        s = rand_scalar(ecmath.ED_L)
+        pts.append(ecmath.ed_to_affine(
+            ecmath.ed_scalar_mul(s, ecmath.ed_to_extended(ecmath.ED_B))))
+    return pts
+
+
+def test_ed_add_double_matches_host():
+    pts = ed_rand_points(4)
+    qts = ed_rand_points(4)
+    Pb = ed_ops._pack_point_ext(pts)
+    Qb = ed_ops._pack_point_ext(qts)
+    got_add = ed_ops.add(Pb, Qb)
+    got_dbl = ed_ops.double(Pb)
+    for i, (pa, qa) in enumerate(zip(pts, qts)):
+        want = ecmath.ed_to_affine(ecmath.ed_point_add(
+            ecmath.ed_to_extended(pa), ecmath.ed_to_extended(qa)))
+        x, y, z, _ = (F.from_limbs(c[i]) for c in got_add)
+        zi = pow(z, ecmath.ED_P - 2, ecmath.ED_P)
+        assert (x * zi % ecmath.ED_P, y * zi % ecmath.ED_P) == want
+        want_d = ecmath.ed_to_affine(ecmath.ed_point_double(ecmath.ed_to_extended(pa)))
+        x, y, z, _ = (F.from_limbs(c[i]) for c in got_dbl)
+        zi = pow(z, ecmath.ED_P - 2, ecmath.ED_P)
+        assert (x * zi % ecmath.ED_P, y * zi % ecmath.ED_P) == want_d
+
+
+def test_ed25519_verify_batch():
+    items, want = [], []
+    for i in range(8):
+        seed = RNG.bytes(32)
+        pub = ecmath.ed25519_public_key(seed)
+        msg = RNG.bytes(40 + i)
+        sig = ecmath.ed25519_sign(seed, msg)
+        if i % 4 == 1:  # corrupt signature
+            sig = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+        if i % 4 == 2:  # corrupt message
+            msg = msg[:-1] + bytes([msg[-1] ^ 0xFF])
+        if i % 4 == 3:  # wrong key
+            pub = ecmath.ed25519_public_key(RNG.bytes(32))
+        items.append((pub, sig, msg))
+        want.append(ecmath.ed25519_verify(pub, msg, sig))
+    got = ed_ops.verify_batch(items)
+    assert list(got) == want
+    assert want[0] and not all(want)  # sanity: mix of verdicts
+
+
+def test_ed25519_malformed_inputs():
+    seed = RNG.bytes(32)
+    pub = ecmath.ed25519_public_key(seed)
+    msg = b"hello"
+    sig = ecmath.ed25519_sign(seed, msg)
+    bad_s = sig[:32] + (ecmath.ED_L + 1).to_bytes(32, "little")  # s >= L
+    items = [
+        (b"\xff" * 32, sig, msg),        # non-decompressible key
+        (pub, b"\x00" * 63, msg),        # short signature
+        (pub, bad_s, msg),
+        (pub, sig, msg),                 # control: valid
+    ]
+    got = ed_ops.verify_batch(items)
+    assert list(got) == [False, False, False, True]
+
+
+# ---------------------------------------------------------------------------
+# ECDSA secp256k1 / secp256r1
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("curve", [ecmath.SECP256K1, ecmath.SECP256R1],
+                         ids=lambda c: c.name)
+def test_wc_add_matches_host(curve):
+    pts = [curve.mul(rand_scalar(curve.n), curve.g) for _ in range(4)]
+    qts = [curve.mul(rand_scalar(curve.n), curve.g) for _ in range(4)]
+    qts[1] = pts[1]  # doubling case through the complete formula
+    Pb = (F.to_limbs([p[0] for p in pts]), F.to_limbs([p[1] for p in pts]),
+          F.to_limbs([1] * 4))
+    Qb = (F.to_limbs([q[0] for q in qts]), F.to_limbs([q[1] for q in qts]),
+          F.to_limbs([1] * 4))
+    X, Y, Z = wc_ops.add(Pb, Qb, curve)
+    for i, (pa, qa) in enumerate(zip(pts, qts)):
+        want = curve.add(pa, qa)
+        x, y, z = F.from_limbs(X[i]), F.from_limbs(Y[i]), F.from_limbs(Z[i])
+        zi = pow(z, curve.p - 2, curve.p)
+        assert (x * zi % curve.p, y * zi % curve.p) == want
+
+
+@pytest.mark.parametrize(
+    "curve",
+    [ecmath.SECP256K1,
+     # r1's 224-bit Solinas fold constant makes its kernel a multi-minute XLA
+     # compile; the shared kernel code is covered by k1, and r1 point math by
+     # test_wc_add_matches_host.
+     pytest.param(ecmath.SECP256R1, marks=pytest.mark.slow)],
+    ids=lambda c: c.name)
+def test_ecdsa_verify_batch(curve):
+    items, want = [], []
+    for i in range(8):
+        priv = rand_scalar(curve.n - 1) + 1
+        pub = curve.mul(priv, curve.g)
+        msg = RNG.bytes(30 + i)
+        r, s = ecmath.ecdsa_sign(curve, priv, msg)
+        if i % 4 == 1:
+            r = (r + 1) % curve.n or 1
+        if i % 4 == 2:
+            msg = msg + b"!"
+        if i % 4 == 3:
+            pub = curve.mul(rand_scalar(curve.n - 1) + 1, curve.g)
+        items.append((pub, msg, r, s))
+        want.append(ecmath.ecdsa_verify(curve, pub, msg, r, s))
+    got = wc_ops.verify_batch(curve, items)
+    assert list(got) == want
+    assert want[0] and not all(want)
+
+
+def test_ecdsa_rejects_high_s_and_off_curve():
+    curve = ecmath.SECP256K1
+    priv = rand_scalar(curve.n - 1) + 1
+    pub = curve.mul(priv, curve.g)
+    msg = b"m"
+    r, s = ecmath.ecdsa_sign(curve, priv, msg)
+    items = [
+        (pub, msg, r, curve.n - s),            # malleated high-s twin
+        ((pub[0], (pub[1] + 1) % curve.p), msg, r, s),  # off-curve key
+        (None, msg, r, s),                      # missing key
+        (pub, msg, r, s),                       # control
+    ]
+    got = wc_ops.verify_batch(curve, items)
+    assert list(got) == [False, False, False, True]

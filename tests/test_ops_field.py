@@ -1,11 +1,16 @@
-"""Differential tests of the device limb field arithmetic vs Python ints."""
+"""Differential tests of the device limb field arithmetic vs Python ints.
+
+The field ops are lazily reduced (relaxed limbs < 1.5*2^16, any residue
+mod p) — tests canonicalise with F.canon before comparing against Python
+modular arithmetic, and separately check the relaxed-limb invariant.
+"""
 import numpy as np
 import pytest
 
 from corda_tpu.ops import field as F
 
 RNG = np.random.default_rng(42)
-PRIMES = [F.P25519, F.PSECP]
+PRIMES = [F.P25519, F.PSECP, F.PSECR1]
 
 
 def rand_elems(p, n=64):
@@ -15,6 +20,15 @@ def rand_elems(p, n=64):
     return vals
 
 
+def canon_int(a, p):
+    """Device array → canonical Python ints, asserting the lazy invariant:
+    limbs 0..14 < LMAX, limb 15 < 2^18 (field.py module contract)."""
+    arr = np.asarray(a, dtype=np.uint64)
+    assert (arr[..., :15] < F.LMAX).all(), "INV violated: limb >= 1.5*2^16"
+    assert (arr[..., 15] < F.LIMB15_MAX).all(), "INV violated: limb15 >= 2^18"
+    return F.from_limbs(F.canon(a, p))
+
+
 @pytest.mark.parametrize("p", PRIMES)
 def test_limb_roundtrip(p):
     vals = rand_elems(p)
@@ -22,9 +36,32 @@ def test_limb_roundtrip(p):
 
 
 @pytest.mark.parametrize("p", PRIMES)
+def test_canon(p):
+    # canon must reduce any 16-limb value (up to 2^256-1) below p.
+    vals = [0, 1, p - 1, p, p + 1, 2 * p - 1, (1 << 256) - 1, (1 << 256) - 2]
+    vals = [v for v in vals if v < (1 << 256)]
+    out = F.from_limbs(F.canon(jnp_arr(vals), p))
+    assert out == [v % p for v in vals]
+
+
+def jnp_arr(vals):
+    import jax.numpy as jnp
+    return jnp.asarray(F.to_limbs(vals))
+
+
+@pytest.mark.parametrize("p", PRIMES)
 def test_mul(p):
     a, b = rand_elems(p), rand_elems(p)
-    out = F.from_limbs(F.mul(F.to_limbs(a), F.to_limbs(b), p))
+    out = canon_int(F.mul(F.to_limbs(a), F.to_limbs(b), p), p)
+    assert out == [(x * y) % p for x, y in zip(a, b)]
+
+
+@pytest.mark.parametrize("p", PRIMES)
+def test_mul_lazy_inputs(p):
+    # inputs anywhere in [0, 2^256) must still multiply correctly mod p
+    a = [(1 << 256) - 1 - i for i in range(8)] + rand_elems(p, 8)
+    b = rand_elems(p, 8) + [(1 << 256) - 17 - i for i in range(8)]
+    out = canon_int(F.mul(F.to_limbs(a), F.to_limbs(b), p), p)
     assert out == [(x * y) % p for x, y in zip(a, b)]
 
 
@@ -32,16 +69,26 @@ def test_mul(p):
 def test_add_sub_neg(p):
     a, b = rand_elems(p), rand_elems(p)
     la, lb = F.to_limbs(a), F.to_limbs(b)
-    assert F.from_limbs(F.add(la, lb, p)) == [(x + y) % p for x, y in zip(a, b)]
-    assert F.from_limbs(F.sub(la, lb, p)) == [(x - y) % p for x, y in zip(a, b)]
-    assert F.from_limbs(F.neg(la, p)) == [(-x) % p for x in a]
+    assert canon_int(F.add(la, lb, p), p) == [(x + y) % p for x, y in zip(a, b)]
+    assert canon_int(F.sub(la, lb, p), p) == [(x - y) % p for x, y in zip(a, b)]
+    assert canon_int(F.neg(la, p), p) == [(-x) % p for x in a]
+
+
+@pytest.mark.parametrize("p", PRIMES)
+def test_add_sub_lazy_inputs(p):
+    top = (1 << 256) - 1
+    a = [top, top, 0, top - 5]
+    b = [top, 0, top, 17]
+    la, lb = F.to_limbs(a), F.to_limbs(b)
+    assert canon_int(F.add(la, lb, p), p) == [(x + y) % p for x, y in zip(a, b)]
+    assert canon_int(F.sub(la, lb, p), p) == [(x - y) % p for x, y in zip(a, b)]
 
 
 @pytest.mark.parametrize("p", PRIMES)
 def test_mul_const(p):
     a = rand_elems(p)
     for c in [0, 1, 2, 8, 38, 977, 121666]:
-        out = F.from_limbs(F.mul_const(F.to_limbs(a), c, p))
+        out = canon_int(F.mul_const(F.to_limbs(a), c, p), p)
         assert out == [(x * c) % p for x in a]
 
 
@@ -49,15 +96,27 @@ def test_mul_const(p):
 def test_predicates(p):
     a = rand_elems(p, 8)
     la = F.to_limbs(a)
-    assert list(np.asarray(F.eq(la, la))) == [True] * 8
-    assert list(np.asarray(F.is_zero(la))) == [v == 0 for v in a]
+    assert list(np.asarray(F.eq(la, la, p))) == [True] * 8
+    assert list(np.asarray(F.is_zero(la, p))) == [v == 0 for v in a]
     lb = F.to_limbs(a[::-1])
-    assert list(np.asarray(F.eq(la, lb))) == [x == y for x, y in zip(a, a[::-1])]
+    assert list(np.asarray(F.eq(la, lb, p))) == [x == y for x, y in zip(a, a[::-1])]
+    # lazy congruence: v and v+p are equal mod p though limb-distinct
+    small = [3, 9]
+    shifted = [v + p for v in small]
+    assert list(np.asarray(F.eq(F.to_limbs(small), F.to_limbs(shifted), p))) == [True, True]
 
 
 @pytest.mark.parametrize("p", PRIMES)
 def test_pow_small(p):
     a = rand_elems(p, 8)
     la = F.to_limbs(a)
-    out = F.from_limbs(F.pow_const(la, 65537, p))
+    out = canon_int(F.pow_const(la, 65537, p), p)
     assert out == [pow(x, 65537, p) for x in a]
+
+
+@pytest.mark.parametrize("p", PRIMES[:2])
+def test_inv(p):
+    a = [v or 1 for v in rand_elems(p, 8)]
+    la = F.to_limbs(a)
+    out = canon_int(F.inv(la, p), p)
+    assert out == [pow(x, p - 2, p) for x in a]

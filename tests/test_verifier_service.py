@@ -1,0 +1,116 @@
+"""Verifier-service tests: async SPI, device-batched signature checking.
+
+Reference analogs: InMemoryTransactionVerifierService behavior, the
+OutOfProcess service's metrics wiring (OutOfProcessTransactionVerifierService.kt:33-45),
+and VerifierTests.kt's "all transactions verify / invalid one fails" cases.
+"""
+import pytest
+
+from corda_tpu.core.contracts import (Command, StateRef, TransactionState)
+from corda_tpu.core.crypto import generate_keypair
+from corda_tpu.core.crypto.schemes import (ECDSA_SECP256K1_SHA256,
+                                           EDDSA_ED25519_SHA512)
+from corda_tpu.core.crypto.signatures import Crypto, SignatureException
+from corda_tpu.core.identity import Party
+from corda_tpu.core.transactions import (SignaturesMissingException,
+                                         SignedTransaction, WireTransaction)
+from corda_tpu.testing import (DUMMY_NOTARY_NAME, DummyContract, DummyState,
+                               MockServices)
+from corda_tpu.verifier import (SignatureBatcher,
+                                InMemoryTransactionVerifierService,
+                                TpuTransactionVerifierService,
+                                make_verifier_service)
+
+NOTARY_KP = generate_keypair(entropy=b"\x20" * 32)
+NOTARY = Party(DUMMY_NOTARY_NAME, NOTARY_KP.public)
+ALICE_KP = generate_keypair(entropy=b"\x21" * 32)
+ALICE_K1_KP = generate_keypair(ECDSA_SECP256K1_SHA256, entropy=b"\x22" * 32)
+
+
+def make_issue_stx(services, owner_kp=ALICE_KP):
+    wtx = WireTransaction(
+        outputs=(TransactionState(DummyState(7, (owner_kp.public,)), NOTARY),),
+        commands=(Command(DummyContract.Create(), (owner_kp.public,)),),
+        notary=NOTARY, must_sign=(owner_kp.public,))
+    return services.sign_transaction(wtx, owner_kp.public)
+
+
+@pytest.fixture
+def services():
+    return MockServices(key_pairs=[NOTARY_KP, ALICE_KP, ALICE_K1_KP],
+                        parties=[NOTARY])
+
+
+def test_in_memory_service_verifies(services):
+    stx = make_issue_stx(services)
+    svc = InMemoryTransactionVerifierService()
+    fut = svc.verify(stx.to_ledger_transaction(services))
+    assert fut.result(timeout=30) is None
+    snap = svc.metrics.snapshot()
+    assert snap["Verification.Success"]["count"] == 1
+    svc.shutdown()
+
+
+def test_in_memory_service_propagates_failure(services):
+    from corda_tpu.core.contracts import SignersMissing
+    wtx = WireTransaction(
+        outputs=(TransactionState(DummyState(7, (ALICE_KP.public,)), NOTARY),),
+        commands=(Command(DummyContract.Create(), (ALICE_KP.public,)),),
+        notary=NOTARY, must_sign=())  # required signer missing
+    stx = services.sign_transaction(wtx, ALICE_KP.public)
+    svc = InMemoryTransactionVerifierService()
+    fut = svc.verify(stx.to_ledger_transaction(services))
+    with pytest.raises(SignersMissing):
+        fut.result(timeout=30)
+    assert svc.metrics.snapshot()["Verification.Failure"]["count"] == 1
+    svc.shutdown()
+
+
+def test_signature_batcher_mixed_schemes(services):
+    batcher = SignatureBatcher(max_latency_s=0.01)
+    content = b"batched content"
+    futures, want = [], []
+    for i in range(6):
+        kp = [ALICE_KP, ALICE_K1_KP, NOTARY_KP][i % 3]
+        sig = Crypto.sign_with_key(kp, content)
+        sig_bytes = sig.bytes if i % 4 != 3 else sig.bytes[:-2] + b"\x00\x00"
+        futures.append(batcher.submit(kp.public, sig_bytes, content))
+        want.append(Crypto.is_valid(kp.public, sig_bytes, content))
+    got = [f.result(timeout=120) for f in futures]
+    assert got == want
+    assert False in got and True in got
+    assert batcher.metrics.snapshot()["SigBatcher.Checked"]["count"] == 6
+    assert batcher.metrics.snapshot()["SigBatcher.InFlight"]["value"] == 0
+    batcher.close()
+
+
+def test_tpu_service_full_path(services):
+    svc = TpuTransactionVerifierService()
+    stx = make_issue_stx(services)
+    assert svc.verify_signed(stx, services).result(timeout=120) is None
+
+    # corrupted signature → SignatureException from the device verdict
+    bad_sig = stx.sigs[0].__class__(
+        stx.sigs[0].bytes[:-1] + bytes([stx.sigs[0].bytes[-1] ^ 1]),
+        stx.sigs[0].by)
+    bad_stx = SignedTransaction(stx.tx_bits, (bad_sig,))
+    with pytest.raises(SignatureException):
+        svc.verify_signed(bad_stx, services).result(timeout=120)
+
+    # signature by the wrong key → coverage failure
+    k1_stx_wtx = stx.tx
+    other = SignedTransaction.of(
+        k1_stx_wtx, [services.sign(k1_stx_wtx.id.bytes, ALICE_K1_KP.public)])
+    with pytest.raises(SignaturesMissingException):
+        svc.verify_signed(other, services).result(timeout=120)
+    svc.shutdown()
+
+
+def test_make_verifier_service_seam():
+    assert isinstance(make_verifier_service("InMemory"),
+                      InMemoryTransactionVerifierService)
+    svc = make_verifier_service("Tpu")
+    assert isinstance(svc, TpuTransactionVerifierService)
+    svc.shutdown()
+    with pytest.raises(ValueError):
+        make_verifier_service("Bogus")
