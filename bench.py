@@ -26,20 +26,21 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 from corda_tpu.core.crypto import ecmath
 from corda_tpu.ops import weierstrass as wc_ops
 
-BATCH = 256
-REPS = 4
+BATCH = 8192    # throughput saturates past ~8k (fixed dispatch cost amortized)
+UNIQUE = 512    # distinct signatures (host signing is pure Python; tile up)
+REPS = 3
 
 
 def make_items(n: int):
     rng = np.random.default_rng(123)
-    items = []
-    for _ in range(n):
+    base = []
+    for _ in range(min(n, UNIQUE)):
         priv = int.from_bytes(rng.bytes(32), "little") % (ecmath.SECP256K1.n - 1) + 1
         pub = ecmath.SECP256K1.mul(priv, ecmath.SECP256K1.g)
         msg = rng.bytes(64)
         r, s = ecmath.ecdsa_sign(ecmath.SECP256K1, priv, msg)
-        items.append((priv, pub, msg, r, s))
-    return items
+        base.append((priv, pub, msg, r, s))
+    return (base * (n // len(base) + 1))[:n]
 
 
 def host_baseline_rate(items) -> float:
@@ -67,12 +68,13 @@ def device_rate(items) -> float:
     u1, u2, q, rc, pre = wc_ops.prepare_batch(ecmath.SECP256K1, kitems)
     assert pre.all()
     fn = wc_ops._verify_kernel
-    ok = jax.block_until_ready(fn(u1, u2, q, rc, "secp256k1"))  # compile+warm
-    assert bool(np.asarray(ok).all()), "benchmark signatures must all verify"
+    ok = np.asarray(fn(u1, u2, q, rc, "secp256k1"))  # compile + warm
+    assert bool(ok.all()), "benchmark signatures must all verify"
     t0 = time.perf_counter()
     for _ in range(REPS):
-        ok = fn(u1, u2, q, rc, "secp256k1")
-    jax.block_until_ready(ok)
+        # the host copy is a hard sync: async dispatch through the device
+        # tunnel makes block_until_ready alone under-measure
+        ok = np.asarray(fn(u1, u2, q, rc, "secp256k1"))
     dt = time.perf_counter() - t0
     return len(items) * REPS / dt
 

@@ -356,3 +356,31 @@ def ecdsa_sig_from_der(data: bytes) -> tuple[int, int]:
     if idx != len(data):
         raise ValueError("trailing bytes after DER signature")
     return r, s
+
+
+# ---------------------------------------------------------------------------
+# GLV endomorphism for secp256k1 (verification speed: halves ladder length)
+# ---------------------------------------------------------------------------
+# secp256k1 has an efficient endomorphism phi(x, y) = (beta*x, y) = [lambda]P
+# (j-invariant 0 curve). Scalars split as k = k1 + k2*lambda (mod n) with
+# |k1|, |k2| < 2^128 via the standard lattice basis (GLV 2001; the constants
+# are the well-known public secp256k1 values). Used by the device ECDSA kernel
+# to run a 4-scalar 129-bit Shamir ladder instead of a 2-scalar 256-bit one.
+
+SECP256K1_LAMBDA = 0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72
+SECP256K1_BETA = 0x7AE96A2B657C07106E64479EAC3434E99CF0497512F58995C1396C28719501EE
+_GLV_A1 = 0x3086D221A7D46BCDE86C90E49284EB15
+_GLV_B1 = -0xE4437ED6010E88286F547FA90ABFE4C3
+_GLV_A2 = 0x114CA50F7A8E2F3F657C1108D9D44CFD8
+_GLV_B2 = _GLV_A1
+
+
+def glv_decompose(k: int) -> tuple[int, int]:
+    """k (mod n) -> (k1, k2), signed, |k1|,|k2| < 2^128, with
+    k1 + k2*lambda == k (mod n)."""
+    n = SECP256K1.n
+    c1 = (_GLV_B2 * k + n // 2) // n
+    c2 = (-_GLV_B1 * k + n // 2) // n
+    k1 = k - c1 * _GLV_A1 - c2 * _GLV_A2
+    k2 = -c1 * _GLV_B1 - c2 * _GLV_B2
+    return k1, k2

@@ -112,14 +112,15 @@ def test_wc_add_matches_host(curve):
 
 
 @pytest.mark.parametrize(
-    "curve",
-    [ecmath.SECP256K1,
+    "curve,use_glv",
+    [(ecmath.SECP256K1, False),
+     (ecmath.SECP256K1, True),   # endomorphism half-ladder path
      # r1's 224-bit Solinas fold constant makes its kernel a multi-minute XLA
      # compile; the shared kernel code is covered by k1, and r1 point math by
      # test_wc_add_matches_host.
-     pytest.param(ecmath.SECP256R1, marks=pytest.mark.slow)],
-    ids=lambda c: c.name)
-def test_ecdsa_verify_batch(curve):
+     pytest.param(ecmath.SECP256R1, False, marks=pytest.mark.slow)],
+    ids=lambda v: v.name if hasattr(v, "name") else ("glv" if v else "plain"))
+def test_ecdsa_verify_batch(curve, use_glv):
     items, want = [], []
     for i in range(8):
         priv = rand_scalar(curve.n - 1) + 1
@@ -134,7 +135,7 @@ def test_ecdsa_verify_batch(curve):
             pub = curve.mul(rand_scalar(curve.n - 1) + 1, curve.g)
         items.append((pub, msg, r, s))
         want.append(ecmath.ecdsa_verify(curve, pub, msg, r, s))
-    got = wc_ops.verify_batch(curve, items)
+    got = wc_ops.verify_batch(curve, items, use_glv=use_glv)
     assert list(got) == want
     assert want[0] and not all(want)
 
