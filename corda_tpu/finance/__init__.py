@@ -1,4 +1,6 @@
 """Finance: reference contracts + flows (the `finance/` module of the
 reference — Cash, CommercialPaper, Obligation and the cash flows)."""
 from .cash import Cash, CashState  # noqa: F401
+from .commercial_paper import CommercialPaper, CommercialPaperState  # noqa: F401
 from .flows import CashIssueFlow, CashPaymentFlow, CashExitFlow  # noqa: F401
+from .trade import BuyerFlow, SellerFlow  # noqa: F401

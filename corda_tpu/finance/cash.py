@@ -105,8 +105,9 @@ class IssueClause(Clause):
                 tx.id, "Issued amount must be positive")
         issuer_key = token.issuer.party.owning_key
         for cmd in issue_cmds:
-            if not any(issuer_key.is_fulfilled_by({k}) or k == issuer_key
-                       for k in cmd.signers):
+            # fulfil against the signer SET (a composite issuer key needs its
+            # threshold met across several leaf signatures)
+            if not issuer_key.is_fulfilled_by(set(cmd.signers)):
                 raise TransactionVerificationException(
                     tx.id, "Issue command must be signed by the issuer")
         return {c.value for c in issue_cmds}
@@ -202,26 +203,40 @@ class Cash(Contract):
                        coins: list, change_owner: PublicKey) -> list[PublicKey]:
         """Add inputs/outputs moving `amount` (Amount[Currency]) from `coins`
         (StateAndRefs) to `to`, with change back to `change_owner`. Returns the
-        keys that must sign."""
-        gathered = 0
-        used = []
+        keys that must sign.
+
+        Coins must all be in `amount`'s currency (callers filter at selection)
+        but may span issuers: conservation holds per (issuer, currency) token
+        group, so the payment is emitted as one output per issuer token drawn
+        on, with per-token change (OnLedgerAsset.kt's grouped spend)."""
+        used, gathered = [], 0
         for sar in coins:
+            if sar.state.data.amount.token.product != amount.token:
+                raise ValueError(
+                    f"Coin in {sar.state.data.amount.token.product}, "
+                    f"spend is in {amount.token}")
             used.append(sar)
             gathered += sar.state.data.amount.quantity
             if gathered >= amount.quantity:
                 break
         if gathered < amount.quantity:
             raise InsufficientBalanceException(amount.quantity - gathered)
-        token = used[0].state.data.amount.token
         notary = used[0].state.notary
+        by_token: dict = {}
         for sar in used:
             builder.add_input_state(sar)
-        builder.add_output_state(
-            CashState(Amount(amount.quantity, token), to), notary)
-        if gathered > amount.quantity:
-            builder.add_output_state(
-                CashState(Amount(gathered - amount.quantity, token),
-                          change_owner), notary)
+            token = sar.state.data.amount.token
+            by_token[token] = by_token.get(token, 0) + sar.state.data.amount.quantity
+        need = amount.quantity
+        for token, total in by_token.items():
+            pay = min(need, total)
+            need -= pay
+            if pay:
+                builder.add_output_state(CashState(Amount(pay, token), to),
+                                         notary)
+            if total > pay:
+                builder.add_output_state(
+                    CashState(Amount(total - pay, token), change_owner), notary)
         keys = sorted({sar.state.data.owner for sar in used})
         builder.add_command(Move(), *keys)
         return keys

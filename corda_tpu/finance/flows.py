@@ -62,7 +62,8 @@ class CashPaymentFlow(FlowLogic):
         lock_id = self.run_id or "payment"
         coins = hub.vault.try_lock_states_for_spending(
             lock_id, self.amount.quantity, CashState,
-            quantity_of=lambda s: s.amount.quantity)
+            quantity_of=lambda s: s.amount.quantity,
+            state_filter=lambda s: s.amount.token.product == self.amount.token)
         if not coins:
             raise FlowException(f"Insufficient cash to pay {self.amount}")
         try:

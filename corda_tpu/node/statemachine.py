@@ -445,20 +445,26 @@ class StateMachineManager:
     def _complete(self, fsm: FlowStateMachine, result) -> None:
         fsm.done = True
         self._end_sessions(fsm, error=None)
-        self.checkpoints.remove_checkpoint(fsm.run_id)
-        self.flows.pop(fsm.run_id, None)
-        self._cleanup_sessions(fsm)
+        self._finalize(fsm)
         fsm.result_future.set_result(result)
         self._notify("remove", fsm)
 
     def _fail(self, fsm: FlowStateMachine, error: Exception) -> None:
         fsm.done = True
         self._end_sessions(fsm, error=error)
+        self._finalize(fsm)
+        fsm.result_future.set_exception(error)
+        self._notify("remove", fsm)
+
+    def _finalize(self, fsm: FlowStateMachine) -> None:
         self.checkpoints.remove_checkpoint(fsm.run_id)
         self.flows.pop(fsm.run_id, None)
         self._cleanup_sessions(fsm)
-        fsm.result_future.set_exception(error)
-        self._notify("remove", fsm)
+        # auto-release any vault soft locks held under this flow's id —
+        # VaultSoftLockManager parity (locks must not outlive their flow)
+        vault = getattr(self.hub, "vault", None)
+        if vault is not None:
+            vault.soft_lock_release(fsm.run_id)
 
     def _end_sessions(self, fsm: FlowStateMachine, error) -> None:
         for sess in fsm.sessions.values():

@@ -147,14 +147,18 @@ class NodeVaultService:
     # -- coin selection (the spend path of OnLedgerAsset) --------------------
     def try_lock_states_for_spending(self, lock_id: str, amount_quantity: int,
                                      state_type: type,
-                                     quantity_of=lambda s: s.amount.quantity
-                                     ) -> list[StateAndRef]:
+                                     quantity_of=lambda s: s.amount.quantity,
+                                     state_filter=None) -> list[StateAndRef]:
         """Greedy selection of unlocked fungible states covering the quantity;
-        atomically soft-locks the selection (unconsumedStatesForSpending)."""
+        atomically soft-locks the selection (unconsumedStatesForSpending).
+        ``state_filter`` restricts eligibility — e.g. to one currency, so a
+        multi-currency vault never pays a USD price in GBP coins."""
         with self._lock:
             selected, total = [], 0
             for sar in self._unconsumed.values():
                 if not isinstance(sar.state.data, state_type):
+                    continue
+                if state_filter is not None and not state_filter(sar.state.data):
                     continue
                 if sar.ref in self._soft_locks:
                     continue

@@ -1,0 +1,1 @@
+"""Sample applications (the reference's samples/ demos re-hosted)."""

@@ -1,0 +1,224 @@
+"""Out-of-process verification: request/response queues + worker pool.
+
+Reference parity:
+- `VerifierApi.VerificationRequest{verificationId, transaction,
+  responseAddress}` / `VerificationResponse{verificationId, exception?}`
+  (node-api/.../VerifierApi.kt:17-59)
+- the standalone verifier worker loop (verifier/.../Verifier.kt:42-79):
+  deserialize the LedgerTransaction, run `.verify()`, reply exception-or-null
+- competing consumers + redistribution on worker death
+  (VerifierTests.kt:53-71, 73+ "verification redistributes on verifier
+  death"), and the node's warning when no verifier is attached
+  (NodeMessagingClient.kt:200-210)
+
+The queue semantics live in `VerifierRequestQueue` (the Artemis
+`verifier.requests` queue analog): work is dealt round-robin to attached
+workers, outstanding work is tracked per worker, and a worker's detachment
+requeues everything it held. Transport-independent — the deterministic
+in-memory bus in tests, the TCP plane in production.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any
+
+from ..core.serialization import deserialize, register_type, serialize
+from ..network.messaging import (TOPIC_VERIFIER_REQUESTS,
+                                 TOPIC_VERIFIER_RESPONSES, TopicSession)
+from ..utils.metrics import MetricRegistry
+from .service import TransactionVerifierService
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class VerificationRequest:
+    verification_id: int
+    transaction: Any          # LedgerTransaction
+    response_address: str
+
+
+@dataclass(frozen=True)
+class VerificationResponse:
+    verification_id: int
+    error_message: str | None
+
+
+@dataclass(frozen=True)
+class WorkerHello:
+    """A worker attaching to the queue (the Artemis consumer-creation analog)."""
+
+    worker_address: str
+
+
+@dataclass(frozen=True)
+class WorkerGoodbye:
+    worker_address: str
+
+
+for _cls in (VerificationRequest, VerificationResponse, WorkerHello,
+             WorkerGoodbye):
+    register_type(f"verifier.{_cls.__name__}", _cls)
+
+
+class VerifierRequestQueue:
+    """Node-side queue with competing-consumer semantics. Attach it to the
+    node's messaging; workers announce themselves with WorkerHello."""
+
+    def __init__(self, network_service):
+        self.network_service = network_service
+        self._workers: list[str] = []
+        self._rr = 0
+        self._pending: list[VerificationRequest] = []      # no worker yet
+        self._outstanding: dict[str, list[VerificationRequest]] = {}
+        self._dealt: dict[int, str] = {}                   # vid -> worker
+        network_service.add_message_handler(
+            TopicSession(TOPIC_VERIFIER_REQUESTS), self._on_control)
+
+    # -- worker membership ---------------------------------------------------
+    def _on_control(self, msg) -> None:
+        payload = deserialize(msg.data)
+        if isinstance(payload, WorkerHello):
+            if payload.worker_address not in self._workers:
+                self._workers.append(payload.worker_address)
+                self._outstanding.setdefault(payload.worker_address, [])
+            self._drain()
+        elif isinstance(payload, WorkerGoodbye):
+            self.detach_worker(payload.worker_address)
+
+    def detach_worker(self, worker: str) -> None:
+        """Worker death: requeue everything it held (broker redelivery)."""
+        if worker in self._workers:
+            self._workers.remove(worker)
+        held = self._outstanding.pop(worker, [])
+        for req in held:
+            self._dealt.pop(req.verification_id, None)
+        if held:
+            log.info("requeueing %d verifications from dead worker %s",
+                     len(held), worker)
+        self._pending = held + self._pending
+        self._drain()
+
+    @property
+    def worker_count(self) -> int:
+        return len(self._workers)
+
+    # -- dispatch ------------------------------------------------------------
+    def submit(self, request: VerificationRequest) -> None:
+        self._pending.append(request)
+        if not self._workers:
+            log.warning("verification request queued but no verifier is "
+                        "attached (reference warns every 10s here)")
+        self._drain()
+
+    def acknowledge(self, verification_id: int) -> None:
+        """Retire a completed request from its worker's outstanding list."""
+        worker = self._dealt.pop(verification_id, None)
+        if worker is None:
+            return
+        held = self._outstanding.get(worker, [])
+        self._outstanding[worker] = [r for r in held
+                                     if r.verification_id != verification_id]
+
+    def _drain(self) -> None:
+        while self._pending and self._workers:
+            req = self._pending.pop(0)
+            worker = self._workers[self._rr % len(self._workers)]
+            self._rr += 1
+            self._outstanding[worker].append(req)
+            self._dealt[req.verification_id] = worker
+            self.network_service.send(TopicSession(TOPIC_VERIFIER_REQUESTS),
+                                      serialize(req), worker)
+
+
+class OutOfProcessTransactionVerifierService(TransactionVerifierService):
+    """Async verify(ltx) backed by the worker pool
+    (OutOfProcessTransactionVerifierService.kt:18-71: nonce → handle map,
+    duration/success/failure/in-flight metrics, response consumer)."""
+
+    def __init__(self, network_service, metrics: MetricRegistry | None = None):
+        self.metrics = metrics if metrics is not None else MetricRegistry()
+        self.network_service = network_service
+        self.queue = VerifierRequestQueue(network_service)
+        self._ids = itertools.count(1)
+        self._handles: dict[int, Future] = {}
+        self._timers: dict[int, object] = {}
+        network_service.add_message_handler(
+            TopicSession(TOPIC_VERIFIER_RESPONSES), self._on_response)
+        self.metrics.gauge("Verification.InFlightOOP",
+                           lambda: len(self._handles))
+
+    def verify(self, ltx) -> Future:
+        vid = next(self._ids)
+        fut: Future = Future()
+        self._handles[vid] = fut
+        timer = self.metrics.timer("Verification.Duration")
+        timer.__enter__()
+        self._timers[vid] = timer
+        self.queue.submit(VerificationRequest(
+            vid, ltx, self.network_service.my_address))
+        return fut
+
+    def _on_response(self, msg) -> None:
+        resp: VerificationResponse = deserialize(msg.data)
+        fut = self._handles.pop(resp.verification_id, None)
+        timer = self._timers.pop(resp.verification_id, None)
+        if timer is not None:
+            timer.__exit__(None, None, None)
+        if fut is None:
+            return
+        self.queue.acknowledge(resp.verification_id)
+        if resp.error_message is None:
+            self.metrics.meter("Verification.Success").mark()
+            fut.set_result(None)
+        else:
+            self.metrics.meter("Verification.Failure").mark()
+            from ..core.contracts.exceptions import TransactionVerificationException
+            fut.set_exception(
+                TransactionVerificationException(None, resp.error_message))
+
+
+class VerifierWorker:
+    """The worker half (Verifier.kt:42-79): attach, consume, verify, reply.
+    Stateless — run N of them against one queue; kill any mid-run and its
+    work redistributes."""
+
+    def __init__(self, network_service, queue_address: str):
+        self.network_service = network_service
+        self.queue_address = queue_address
+        self.verified_count = 0
+        self._registration = network_service.add_message_handler(
+            TopicSession(TOPIC_VERIFIER_REQUESTS), self._on_request)
+        self._alive = True
+        network_service.send(TopicSession(TOPIC_VERIFIER_REQUESTS),
+                             serialize(WorkerHello(network_service.my_address)),
+                             queue_address)
+
+    def _on_request(self, msg) -> None:
+        if not self._alive:
+            return
+        req: VerificationRequest = deserialize(msg.data)
+        error = None
+        try:
+            req.transaction.verify()
+        except Exception as e:
+            error = str(e)
+        self.verified_count += 1
+        self.network_service.send(
+            TopicSession(TOPIC_VERIFIER_RESPONSES),
+            serialize(VerificationResponse(req.verification_id, error)),
+            req.response_address)
+
+    def stop(self, announce: bool = True) -> None:
+        """Graceful stop announces Goodbye; a crash (announce=False) relies on
+        the node detaching the worker when it notices (detach_worker)."""
+        self._alive = False
+        self.network_service.remove_message_handler(self._registration)
+        if announce:
+            self.network_service.send(
+                TopicSession(TOPIC_VERIFIER_REQUESTS),
+                serialize(WorkerGoodbye(self.network_service.my_address)),
+                self.queue_address)

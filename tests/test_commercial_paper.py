@@ -1,0 +1,85 @@
+"""CommercialPaper contract unit tests (CommercialPaperTests.kt analog):
+issue/move/redeem clause rules exercised directly at the contract level."""
+import datetime
+
+import pytest
+
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.core.contracts.exceptions import TransactionVerificationException
+from corda_tpu.core.contracts.structures import (AuthenticatedObject, Issued,
+                                                 PartyAndReference, TimeWindow)
+from corda_tpu.core.crypto import generate_keypair
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.core.identity import Party
+from corda_tpu.core.serialization.codec import exact_epoch_micros
+from corda_tpu.core.transactions.ledger import TransactionForContract
+from corda_tpu.finance.cash import CashState
+from corda_tpu.finance.commercial_paper import (CommercialPaper,
+                                                CommercialPaperState)
+
+ISSUER_KP = generate_keypair(entropy=b"\x61" * 32)
+ISSUER = Party("O=MegaCorp, L=London, C=GB", ISSUER_KP.public)
+OWNER_KP = generate_keypair(entropy=b"\x62" * 32)
+
+NOW = datetime.datetime(2026, 7, 1, tzinfo=datetime.timezone.utc)
+NOW_MICROS = exact_epoch_micros(NOW)
+LATER_MICROS = exact_epoch_micros(NOW + datetime.timedelta(days=30))
+
+TOKEN = Issued(PartyAndReference(ISSUER, b"\x01"), USD)
+CP = CommercialPaper()
+
+
+def paper(owner=ISSUER_KP.public, maturity=LATER_MICROS, face=100_000):
+    return CommercialPaperState(PartyAndReference(ISSUER, b"\x01"), owner,
+                                Amount(face, TOKEN), maturity)
+
+
+def ctx(inputs, outputs, commands, at=NOW):
+    tw = TimeWindow.with_tolerance(at, datetime.timedelta(seconds=30))
+    return TransactionForContract(
+        inputs=tuple(inputs), outputs=tuple(outputs), attachments=(),
+        commands=tuple(commands), id=SecureHash.sha256(b"cp-test"),
+        notary=None, time_window=tw)
+
+
+def cmd(data, *keys):
+    return AuthenticatedObject(tuple(keys), (), data)
+
+
+def test_issue_rules():
+    CP.verify(ctx([], [paper()], [cmd(CP.Issue(), ISSUER_KP.public)]))
+    # unsigned by issuer
+    with pytest.raises(TransactionVerificationException, match="issuer"):
+        CP.verify(ctx([], [paper()], [cmd(CP.Issue(), OWNER_KP.public)]))
+    # already matured
+    with pytest.raises(TransactionVerificationException, match="mature"):
+        CP.verify(ctx([], [paper(maturity=NOW_MICROS - 1)],
+                      [cmd(CP.Issue(), ISSUER_KP.public)]))
+
+
+def test_move_rules():
+    CP.verify(ctx([paper()], [paper(owner=OWNER_KP.public)],
+                  [cmd(CP.Move(), ISSUER_KP.public)]))
+    # terms must not change
+    with pytest.raises(TransactionVerificationException, match="terms"):
+        CP.verify(ctx([paper()], [paper(owner=OWNER_KP.public, face=1)],
+                      [cmd(CP.Move(), ISSUER_KP.public)]))
+    # owner must sign
+    with pytest.raises(TransactionVerificationException, match="owner"):
+        CP.verify(ctx([paper()], [paper(owner=OWNER_KP.public)],
+                      [cmd(CP.Move(), OWNER_KP.public)]))
+
+
+def test_redeem_rules():
+    matured = paper(owner=OWNER_KP.public, maturity=NOW_MICROS - 1)
+    payment = CashState(Amount(100_000, TOKEN), OWNER_KP.public)
+    # redemption paying face value to the owner, after maturity
+    CP.verify(ctx([matured], [payment], [cmd(CP.Redeem(), OWNER_KP.public)]))
+    # before maturity
+    with pytest.raises(TransactionVerificationException, match="matured"):
+        CP.verify(ctx([paper(owner=OWNER_KP.public)], [payment],
+                      [cmd(CP.Redeem(), OWNER_KP.public)]))
+    # underpayment
+    small = CashState(Amount(40_000, TOKEN), OWNER_KP.public)
+    with pytest.raises(TransactionVerificationException, match="face value"):
+        CP.verify(ctx([matured], [small], [cmd(CP.Redeem(), OWNER_KP.public)]))

@@ -1,0 +1,65 @@
+"""Trader-demo end-to-end (TraderDemoTest / TwoPartyTradeFlowTests analogs):
+full DvP over MockNetwork — cash issuance, paper issuance, atomic swap."""
+import pytest
+
+from corda_tpu.finance.cash import CashState
+from corda_tpu.finance.commercial_paper import CommercialPaperState
+from corda_tpu.flows import FlowException
+from corda_tpu.samples.trader_demo import dollars, run_demo
+
+
+def test_trader_demo_settles():
+    out = run_demo(price_dollars=1000, face_dollars=1100)
+    final = out["final"]
+    buyer, seller, notary = out["buyer"], out["seller"], out["notary"]
+
+    # three signatures: buyer (cash), seller (paper), notary
+    assert {s.by for s in final.sigs} == {
+        buyer.party.owning_key, seller.party.owning_key,
+        notary.party.owning_key}
+    final.verify_signatures()
+
+    # buyer owns the paper now
+    papers = out["buyer_paper"]
+    assert len(papers) == 1
+    assert papers[0].state.data.owner == buyer.party.owning_key
+
+    # seller received exactly the price
+    assert sum(s.state.data.amount.quantity
+               for s in out["seller_cash"]) == dollars(1000).quantity
+    # buyer kept the change
+    assert sum(s.state.data.amount.quantity
+               for s in out["buyer_cash"]) == dollars(200).quantity
+
+    # both sides recorded the same final transaction
+    assert buyer.services.storage.get_transaction(final.id) is not None
+    assert seller.services.storage.get_transaction(final.id) is not None
+
+    # seller saw its paper consumed
+    assert seller.services.vault.query(CommercialPaperState, status="consumed")
+
+    # the notary's commit log prevents re-selling the same (consumed) paper:
+    # a second SellerFlow over the stale StateAndRef must die with a conflict
+    from corda_tpu.finance.trade import SellerFlow
+    from corda_tpu.flows.library import NotaryException
+    network = out["network"]
+    stale_ref = [s for s in
+                 seller.services.vault.query(CommercialPaperState,
+                                             status="consumed")][0]
+    fsm = seller.start_flow(SellerFlow(buyer.party, stale_ref, dollars(100)))
+    network.run_network()
+    with pytest.raises(NotaryException, match="already consumed"):
+        fsm.result_future.result(timeout=5)
+
+
+def test_buyer_rejects_unaffordable_offer():
+    out = run_demo(price_dollars=1000, face_dollars=1100)
+    network, buyer, seller = out["network"], out["buyer"], out["seller"]
+    # seller (now holding cash, no paper) offers a bogus trade the buyer
+    # cannot pay for: buyer has only $200 left
+    from corda_tpu.finance.trade import SellerFlow
+    paper = out["buyer_paper"][0]  # owned by buyer, seller doesn't own it
+    fsm = seller.start_flow(SellerFlow(buyer.party, paper, dollars(5000)))
+    network.run_network()
+    with pytest.raises(FlowException, match="Insufficient cash"):
+        fsm.result_future.result(timeout=5)
