@@ -1,0 +1,93 @@
+"""CordaRPCClient — connect to a node's RPC surface over the TCP plane.
+
+Reference parity: client/rpc CordaRPCClient → proxy of CordaRPCOps
+(RPCClient.kt / RPCClientProxyHandler.kt): the client opens its own transport
+endpoint, sends framed requests carrying a reply address, correlates
+responses by request id, and surfaces server-side exceptions. Flow results
+are polled (`start_flow_and_wait`) — the reference's observable stream demux
+maps to the feed/snapshot split on this wire.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+from ..core.serialization import deserialize, serialize
+from ..network.messaging import TopicSession
+from ..network.tcp import TcpMessagingService
+from ..node.node import TOPIC_RPC, RpcRequest, RpcResponse
+
+
+class RPCException(Exception):
+    pass
+
+
+class FlowFailedException(RPCException):
+    pass
+
+
+class CordaRPCClient:
+    def __init__(self, host: str, port: int, client_host: str = "127.0.0.1",
+                 timeout_s: float = 30.0):
+        self.node_addr = (host, port)
+        self.timeout_s = timeout_s
+        self._pending: dict[str, object] = {}
+        self._cond = threading.Condition()
+        self._messaging = TcpMessagingService(
+            f"rpc-client-{uuid.uuid4().hex[:8]}", client_host, 0,
+            lambda name: self.node_addr)
+        self._messaging.add_message_handler(TopicSession(TOPIC_RPC, 1),
+                                            self._on_response)
+        self.reply_to = f"{client_host}:{self._messaging.port}"
+
+    # -- plumbing ------------------------------------------------------------
+    def _on_response(self, msg) -> None:
+        resp: RpcResponse = deserialize(msg.data)
+        with self._cond:
+            self._pending[resp.request_id] = resp
+            self._cond.notify_all()
+
+    def call(self, method: str, *args):
+        rid = uuid.uuid4().hex
+        req = RpcRequest(rid, method, list(args), self.reply_to)
+        self._messaging.send(TopicSession(TOPIC_RPC), serialize(req), "node")
+        deadline = time.monotonic() + self.timeout_s
+        with self._cond:
+            while rid not in self._pending:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RPCException(f"RPC {method} timed out")
+                self._cond.wait(timeout=remaining)
+            resp = self._pending.pop(rid)
+        if resp.error is not None:
+            raise RPCException(resp.error)
+        return resp.result
+
+    # -- the proxy surface ---------------------------------------------------
+    def start_flow(self, flow_name: str, *args) -> str:
+        return self.call("start_flow", flow_name, *args)
+
+    def flow_result(self, run_id: str):
+        return self.call("flow_result", run_id)
+
+    def start_flow_and_wait(self, flow_name: str, *args,
+                            timeout_s: float = 60.0, poll_s: float = 0.2):
+        run_id = self.start_flow(flow_name, *args)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            status, value = self.flow_result(run_id)
+            if status == "done":
+                return value
+            if status == "failed":
+                raise FlowFailedException(value)
+            time.sleep(poll_s)
+        raise RPCException(f"flow {run_id} did not finish in {timeout_s}s")
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda *args: self.call(name, *args)
+
+    def close(self) -> None:
+        self._messaging.stop()

@@ -22,6 +22,22 @@ ADD = "ADD"
 REMOVE = "REMOVE"
 
 
+def _signed_payload(node_info_bytes: bytes, serial: int) -> bytes:
+    """What a registration signature covers: the info bytes AND the full
+    serial (a truncated serial would let an attacker replay old info under a
+    higher serial with a matching low byte)."""
+    return node_info_bytes + serial.to_bytes(8, "big")
+
+
+def make_registration(hub, info, serial: int, reg_type: str) -> "NodeRegistration":
+    """Build a signed NodeRegistration with this node's identity key — the
+    single signing convention shared by clients and the map node itself."""
+    info_bytes = serialize(info)
+    sig = hub.key_management.sign(_signed_payload(info_bytes, serial),
+                                  info.legal_identity.owning_key)
+    return NodeRegistration(info_bytes, serial, reg_type, sig)
+
+
 @dataclass(frozen=True)
 class NodeRegistration:
     """A signed add/remove request (NetworkMapService.NodeRegistration)."""
@@ -58,8 +74,9 @@ register_type(
 class NetworkMapService:
     """The directory node's service half. Attach to a node's messaging."""
 
-    def __init__(self, network_service):
+    def __init__(self, network_service, local_cache=None):
         self.network_service = network_service
+        self.local_cache = local_cache  # the hosting node's own map cache
         self._registrations: dict[str, NodeRegistration] = {}  # name -> latest
         self._serials: dict[str, int] = {}
         self._subscribers: set[str] = set()
@@ -72,13 +89,18 @@ class NetworkMapService:
 
     # -- handlers ------------------------------------------------------------
     def _on_register(self, msg) -> None:
-        reg: NodeRegistration = deserialize(msg.data)
+        self.apply_registration(deserialize(msg.data))
+
+    def apply_registration(self, reg: NodeRegistration) -> None:
+        """Validate + apply a signed registration (also used by the map node
+        to publish its own identity at startup)."""
         info: NodeInfo = deserialize(reg.node_info_bytes)
         name = str(info.legal_identity.name)
         # signature must be by the node's own identity key over the info bytes
         if reg.signature.by != info.legal_identity.owning_key:
             return
-        if not reg.signature.is_valid(reg.node_info_bytes + bytes([reg.serial & 0xFF])):
+        if not reg.signature.is_valid(_signed_payload(reg.node_info_bytes,
+                                                      reg.serial)):
             return
         if reg.serial <= self._serials.get(name, -1):
             return  # stale
@@ -87,6 +109,11 @@ class NetworkMapService:
             self._registrations[name] = reg
         else:
             self._registrations.pop(name, None)
+        if self.local_cache is not None:
+            if reg.type == ADD:
+                self.local_cache.add_node(info)
+            else:
+                self.local_cache.remove_node(name)
         self._push(reg)
 
     def _on_fetch(self, msg) -> None:
@@ -115,7 +142,10 @@ class NetworkMapClient:
     def __init__(self, hub, map_node_name: str):
         self.hub = hub
         self.map_node_name = map_node_name
-        self._serial = 0
+        # epoch-millis base so a restarted node (serial counter reset) still
+        # outranks its previous registrations at the map service
+        import time
+        self._serial = int(time.time() * 1000)
         self._fetch_session = 7001  # private response session
         hub.network_service.add_message_handler(
             TopicSession(TOPIC_NETWORK_MAP_PUSH), self._on_push)
@@ -124,12 +154,8 @@ class NetworkMapClient:
             self._on_fetch_response)
 
     def register(self) -> None:
-        info_bytes = serialize(self.hub.my_info)
         self._serial += 1
-        sig = self.hub.key_management.sign(
-            info_bytes + bytes([self._serial & 0xFF]),
-            self.hub.my_info.legal_identity.owning_key)
-        reg = NodeRegistration(info_bytes, self._serial, ADD, sig)
+        reg = make_registration(self.hub, self.hub.my_info, self._serial, ADD)
         self.hub.network_service.send(TopicSession(TOPIC_NETWORK_MAP_REGISTER),
                                       serialize(reg), self.map_node_name)
 

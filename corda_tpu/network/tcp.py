@@ -1,0 +1,190 @@
+"""TCP messaging plane — the production DCN transport between node hosts.
+
+Reference parity: the Artemis broker + TCP transport role
+(ArtemisMessagingServer.kt:88 + ArtemisTcpTransport) re-designed for the
+TPU-host topology: each node listens on one TCP port; peers connect lazily
+and frames carry (topic, session, sender, payload). Handlers dispatch onto
+the node's SerialExecutor (the single node-thread discipline,
+AffinityExecutor parity) so the state machine never sees concurrent calls.
+
+Wire frame: 4-byte big-endian length + canonical-codec bytes of
+[topic, session_id, sender_name, payload]. Undeliverable messages are parked
+and replayed on handler registration (NodeMessagingClient retention), and
+sends to unreachable peers are retried with a delay
+(messageRedeliveryDelaySeconds analog).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Callable
+
+from ..core.serialization import deserialize, serialize
+from ..utils.affinity import SerialExecutor
+from .messaging import (HandlerTable, Message, MessagingService,
+                        MessageHandlerRegistration, TopicSession)
+
+log = logging.getLogger(__name__)
+
+MAX_FRAME = 64 * 1024 * 1024
+REDELIVERY_DELAY_S = 0.5
+MAX_SEND_ATTEMPTS = 10
+
+
+class TcpMessagingService(MessagingService):
+    """One node's transport endpoint: a TCP server + lazy client connections.
+
+    ``resolve_address(name) -> (host, port) | None`` supplies the directory
+    (fed by the network map cache). All sends/receives run on a private
+    asyncio loop thread; inbound handler callbacks run on ``executor``.
+    """
+
+    def __init__(self, my_name: str, host: str, port: int,
+                 resolve_address: Callable[[str], tuple | None],
+                 executor: SerialExecutor | None = None):
+        self._name = my_name
+        self.host = host
+        self.port = port
+        self.resolve_address = resolve_address
+        self.executor = executor if executor is not None else SerialExecutor(
+            f"node-thread({my_name})")
+        self._handlers = HandlerTable()
+        self._undelivered: list[Message] = []
+        self._writers: dict[str, asyncio.StreamWriter] = {}
+        self._send_queues: dict[str, "asyncio.Queue"] = {}
+        self._sender_tasks: dict[str, "asyncio.Task"] = {}
+        self._loop = asyncio.new_event_loop()
+        self._server = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._run_loop, daemon=True,
+                                        name=f"tcp-messaging({my_name})")
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    # -- loop plumbing -------------------------------------------------------
+    def _run_loop(self) -> None:
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._start_server())
+        self._started.set()
+        self._loop.run_forever()
+
+    async def _start_server(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_connection, self.host, self.port)
+        if self.port == 0:  # ephemeral: learn the kernel-assigned port
+            self.port = self._server.sockets[0].getsockname()[1]
+
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                header = await reader.readexactly(4)
+                length = int.from_bytes(header, "big")
+                if length > MAX_FRAME:
+                    raise ValueError(f"frame too large: {length}")
+                body = await reader.readexactly(length)
+                topic, session_id, sender, payload = deserialize(body)
+                msg = Message(TopicSession(topic, session_id), payload,
+                              sender=sender)
+                self.executor.execute(lambda m=msg: self._deliver(m))
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    # -- inbound dispatch ----------------------------------------------------
+    def _deliver(self, msg: Message) -> None:
+        handlers = self._handlers.matching(msg)
+        if not handlers:
+            self._undelivered.append(msg)
+            return
+        for h in handlers:
+            try:
+                h.callback(msg)
+            except Exception:
+                log.exception("message handler failed for %s", msg.topic_session)
+
+    # -- MessagingService ----------------------------------------------------
+    @property
+    def my_address(self) -> str:
+        return self._name
+
+    def send(self, topic_session: TopicSession, payload: bytes,
+             recipient: str) -> None:
+        frame_body = serialize([topic_session.topic, topic_session.session_id,
+                                self._name, payload])
+        frame = len(frame_body).to_bytes(4, "big") + frame_body
+        self._loop.call_soon_threadsafe(self._enqueue_send, recipient, frame)
+
+    def _enqueue_send(self, recipient: str, frame: bytes) -> None:
+        """One outbound queue + sender task per recipient: frames to a peer
+        stay ordered (the per-peer broker queue semantics) and exactly one
+        connection per peer exists — no open_connection races."""
+        q = self._send_queues.get(recipient)
+        if q is None:
+            q = self._send_queues[recipient] = asyncio.Queue()
+            self._sender_tasks[recipient] = self._loop.create_task(
+                self._sender(recipient, q))
+        q.put_nowait(frame)
+
+    async def _sender(self, recipient: str, q: "asyncio.Queue") -> None:
+        while True:
+            frame = await q.get()
+            for attempt in range(MAX_SEND_ATTEMPTS):
+                try:
+                    writer = await self._writer_for(recipient)
+                    writer.write(frame)
+                    await writer.drain()
+                    break
+                except (OSError, ConnectionError, LookupError) as e:
+                    self._writers.pop(recipient, None)
+                    if attempt == MAX_SEND_ATTEMPTS - 1:
+                        log.error("giving up sending to %s: %s", recipient, e)
+                        break
+                    await asyncio.sleep(REDELIVERY_DELAY_S)
+
+    async def _writer_for(self, recipient: str) -> asyncio.StreamWriter:
+        writer = self._writers.get(recipient)
+        if writer is not None and not writer.is_closing():
+            return writer
+        addr = self.resolve_address(recipient)
+        if addr is None:
+            raise LookupError(f"no address known for {recipient!r}")
+        host, port = addr
+        _, writer = await asyncio.open_connection(host, port)
+        self._writers[recipient] = writer
+        return writer
+
+    def add_message_handler(self, topic_session: TopicSession, callback
+                            ) -> MessageHandlerRegistration:
+        reg = self._handlers.add(topic_session, callback)
+
+        def replay():
+            still = []
+            for msg in self._undelivered:
+                if (msg.topic_session.topic == topic_session.topic and
+                        msg.topic_session.session_id == topic_session.session_id):
+                    callback(msg)
+                else:
+                    still.append(msg)
+            self._undelivered[:] = still
+
+        self.executor.execute(replay)
+        return reg
+
+    def remove_message_handler(self, reg: MessageHandlerRegistration) -> None:
+        self._handlers.remove(reg)
+
+    def stop(self) -> None:
+        def _shutdown():
+            for task in self._sender_tasks.values():
+                task.cancel()
+            for w in self._writers.values():
+                w.close()
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+
+        self._loop.call_soon_threadsafe(_shutdown)
+        self._thread.join(timeout=5)

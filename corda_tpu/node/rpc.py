@@ -36,6 +36,11 @@ class StateMachineInfo:
     done: bool
 
 
+from ..core.serialization import register_type as _register_type  # noqa: E402
+
+_register_type("rpc.StateMachineInfo", StateMachineInfo)
+
+
 class FlowPermissionException(Exception):
     pass
 

@@ -1,0 +1,44 @@
+"""Driver integration test: REAL node processes over the TCP plane.
+
+Reference parity: the driver{}-based integration tier (SURVEY.md §4.2) —
+spawns a network-map node, a notary and two party nodes as subprocesses,
+then runs cash issuance + payment across them via RPC, exactly as
+BootTests / NodePerformanceTests drive real nodes.
+"""
+import pytest
+
+import corda_tpu.finance  # noqa: F401 — load the cordapp's wire types client-side
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.testing.driver import driver
+
+
+@pytest.mark.slow
+def test_cash_payment_across_real_nodes(tmp_path):
+    with driver(tmp_path) as dsl:
+        notary = dsl.start_notary_node()
+        alice = dsl.start_node("O=Alice, L=London, C=GB")
+        bob = dsl.start_node("O=Bob, L=Paris, C=FR")
+        dsl.wait_for_network(4)  # map + notary + alice + bob
+
+        notary_party = alice.rpc.notary_identities()[0]
+        alice_party = alice.rpc.node_identity().legal_identity
+        bob_party = bob.rpc.node_identity().legal_identity
+
+        # Alice self-issues $100, then pays Bob $40
+        alice.rpc.start_flow_and_wait(
+            "CashIssueFlow", Amount(10000, USD), b"\x01", alice_party,
+            notary_party)
+        final = alice.rpc.start_flow_and_wait(
+            "CashPaymentFlow", Amount(4000, USD), bob_party)
+        assert final is not None
+
+        # Bob's vault (in a different OS process) shows the $40
+        import time
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            states = bob.rpc.vault_snapshot()
+            if states:
+                break
+            time.sleep(0.5)
+        amounts = [s.state.data.amount.quantity for s in states]
+        assert amounts == [4000]
