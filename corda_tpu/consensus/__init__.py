@@ -1,0 +1,4 @@
+"""Consensus backends for the clustered notary (reference: Copycat Raft via
+RaftUniquenessProvider.kt, BFT-SMaRt via BFTSMaRt.kt)."""
+from .raft import RaftNode, RaftState  # noqa: F401
+from .raft_uniqueness import RaftUniquenessProvider  # noqa: F401

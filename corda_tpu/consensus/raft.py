@@ -1,0 +1,379 @@
+"""Raft replicated log over the messaging plane.
+
+Reference parity: the role Copycat plays for the notary commit log
+(RaftUniquenessProvider.kt:41,101-155 + DistributedImmutableMap.kt) —
+re-implemented natively on this framework's transport: leader election, log
+replication, commitment, and a client-submission path with leader
+forwarding. Works over the deterministic in-memory bus (tests drive `tick()`
+manually — no wall-clock in the protocol core) and the TCP plane (a timer
+thread calls `tick()`).
+
+Simplifications vs full Raft (documented, safe for the notary use case):
+snapshots/compaction and membership changes are not implemented; logs are
+kept in memory with the application results re-derivable by replay.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..core.serialization import deserialize, register_type, serialize
+from ..network.messaging import TopicSession
+
+log = logging.getLogger(__name__)
+
+TOPIC_RAFT = "platform.raft"
+NOOP = "__raft_noop__"
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+ELECTION_TICKS_MIN = 10
+ELECTION_TICKS_MAX = 20
+HEARTBEAT_TICKS = 3
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    term: int
+    entry: Any
+    client: str | None = None       # who to answer after commit
+    request_id: int | None = None
+
+
+@dataclass(frozen=True)
+class RequestVote:
+    term: int
+    candidate: str
+    last_log_index: int
+    last_log_term: int
+
+
+@dataclass(frozen=True)
+class VoteResponse:
+    term: int
+    voter: str
+    granted: bool
+
+
+@dataclass(frozen=True)
+class AppendEntries:
+    term: int
+    leader: str
+    prev_log_index: int
+    prev_log_term: int
+    entries: tuple           # LogEntry...
+    leader_commit: int
+
+
+@dataclass(frozen=True)
+class AppendResponse:
+    term: int
+    follower: str
+    success: bool
+    match_index: int
+
+
+@dataclass(frozen=True)
+class ClientRequest:
+    request_id: int
+    client: str
+    entry: Any
+
+
+@dataclass(frozen=True)
+class ClientResponse:
+    request_id: int
+    result: Any = None
+    error: str | None = None
+    leader_hint: str | None = None
+
+
+for _cls in (LogEntry, RequestVote, VoteResponse, AppendEntries,
+             AppendResponse, ClientRequest, ClientResponse):
+    register_type(f"raft.{_cls.__name__}", _cls)
+
+
+class RaftState:
+    """Persistent + volatile Raft state for one replica."""
+
+    def __init__(self):
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[LogEntry] = []      # 1-based indexing via helpers
+        self.commit_index = 0
+        self.last_applied = 0
+
+    def last_index(self) -> int:
+        return len(self.log)
+
+    def term_at(self, index: int) -> int:
+        if index == 0:
+            return 0
+        return self.log[index - 1].term
+
+
+class RaftNode:
+    """One replica. `apply_fn(entry) -> result` is the state machine
+    (DistributedImmutableMap's commands); called exactly once per committed
+    entry, in log order, on every replica."""
+
+    def __init__(self, node_id: str, peers: list[str], messaging,
+                 apply_fn: Callable[[Any], Any], seed: int | None = None):
+        self.node_id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.messaging = messaging
+        self.apply_fn = apply_fn
+        self.state = RaftState()
+        self.role = FOLLOWER
+        self.leader_id: str | None = None
+        self._rng = random.Random(seed if seed is not None else node_id)
+        self._election_deadline = self._new_election_timeout()
+        self._ticks = 0
+        self._votes: set[str] = set()
+        self._next_index: dict[str, int] = {}
+        self._match_index: dict[str, int] = {}
+        self._request_ids = iter(range(1, 1 << 62))
+        self._pending: dict[int, Future] = {}       # our client requests
+        # One coarse reentrant lock serializes every entry point: ticks from a
+        # timer thread, messages from the transport thread, and submits from
+        # flow threads all mutate the same state.
+        self._lock = threading.RLock()
+        messaging.add_message_handler(TopicSession(TOPIC_RAFT), self._on_message)
+
+    # -- timers --------------------------------------------------------------
+    def _new_election_timeout(self) -> int:
+        return self._rng.randint(ELECTION_TICKS_MIN, ELECTION_TICKS_MAX)
+
+    def tick(self) -> None:
+        """Advance logical time one step (tests call this directly; production
+        wraps it in a timer thread)."""
+        with self._lock:
+            self._tick_locked()
+
+    def _tick_locked(self) -> None:
+        self._ticks += 1
+        if self.role == LEADER:
+            if self._ticks % HEARTBEAT_TICKS == 0:
+                self._broadcast_append()
+            return
+        self._election_deadline -= 1
+        if self._election_deadline <= 0:
+            self._start_election()
+
+    # -- elections -----------------------------------------------------------
+    def _start_election(self) -> None:
+        self.state.current_term += 1
+        self.role = CANDIDATE
+        self.state.voted_for = self.node_id
+        self._votes = {self.node_id}
+        self._election_deadline = self._new_election_timeout()
+        log.debug("%s starts election for term %d", self.node_id,
+                  self.state.current_term)
+        msg = RequestVote(self.state.current_term, self.node_id,
+                          self.state.last_index(),
+                          self.state.term_at(self.state.last_index()))
+        for peer in self.peers:
+            self._post(peer, msg)
+        self._maybe_win()
+
+    def _maybe_win(self) -> None:
+        if self.role == CANDIDATE and len(self._votes) > (len(self.peers) + 1) // 2:
+            self.role = LEADER
+            self.leader_id = self.node_id
+            self._next_index = {p: self.state.last_index() + 1 for p in self.peers}
+            self._match_index = {p: 0 for p in self.peers}
+            log.info("%s is leader for term %d", self.node_id,
+                     self.state.current_term)
+            # a current-term no-op lets _maybe_commit advance over entries
+            # replicated in previous terms (Raft 5.4.2 liveness)
+            self.state.log.append(LogEntry(self.state.current_term, NOOP))
+            self._broadcast_append()
+            self._maybe_commit()
+
+    # -- replication ---------------------------------------------------------
+    def _broadcast_append(self) -> None:
+        for peer in self.peers:
+            self._send_append(peer)
+
+    def _send_append(self, peer: str) -> None:
+        next_i = self._next_index.get(peer, self.state.last_index() + 1)
+        prev = next_i - 1
+        entries = tuple(self.state.log[prev:])
+        self._post(peer, AppendEntries(
+            self.state.current_term, self.node_id, prev,
+            self.state.term_at(prev), entries, self.state.commit_index))
+
+    # -- client submission ---------------------------------------------------
+    def submit(self, entry) -> Future:
+        """Replicate `entry`; the future resolves with apply_fn's result once
+        committed. On a follower, forwards to the known leader. The caller
+        owns the timeout: call `abandon(fut)` if it gives up waiting, so the
+        pending-request table cannot leak."""
+        with self._lock:
+            fut: Future = Future()
+            rid = next(self._request_ids)
+            fut.raft_request_id = rid
+            self._pending[rid] = fut
+            req = ClientRequest(rid, self.node_id, entry)
+            if self.role == LEADER:
+                self._handle_client_request(req)
+            elif self.leader_id is not None:
+                self._post(self.leader_id, req)
+            else:
+                self._pending.pop(rid)
+                fut.set_exception(RuntimeError("no raft leader known"))
+            return fut
+
+    def abandon(self, fut: Future) -> None:
+        """Drop a timed-out submission from the pending table."""
+        with self._lock:
+            self._pending.pop(getattr(fut, "raft_request_id", None), None)
+
+    def _handle_client_request(self, req: ClientRequest) -> None:
+        if self.role != LEADER:
+            self._post(req.client, ClientResponse(
+                req.request_id, error="not leader", leader_hint=self.leader_id))
+            return
+        self.state.log.append(LogEntry(self.state.current_term, req.entry,
+                                       req.client, req.request_id))
+        self._broadcast_append()
+        self._maybe_commit()   # single-node cluster commits immediately
+
+    # -- message handling ----------------------------------------------------
+    def _post(self, peer: str, msg) -> None:
+        self.messaging.send(TopicSession(TOPIC_RAFT), serialize(msg), peer)
+
+    def _observe_term(self, term: int) -> None:
+        if term > self.state.current_term:
+            self.state.current_term = term
+            self.state.voted_for = None
+            self.role = FOLLOWER
+            self.leader_id = None  # stale until the new leader heartbeats
+
+    def _on_message(self, msg) -> None:
+        m = deserialize(msg.data)
+        with self._lock:
+            self._on_message_locked(m)
+
+    def _on_message_locked(self, m) -> None:
+        if isinstance(m, RequestVote):
+            self._on_request_vote(m)
+        elif isinstance(m, VoteResponse):
+            self._on_vote_response(m)
+        elif isinstance(m, AppendEntries):
+            self._on_append(m)
+        elif isinstance(m, AppendResponse):
+            self._on_append_response(m)
+        elif isinstance(m, ClientRequest):
+            self._handle_client_request(m)
+        elif isinstance(m, ClientResponse):
+            self._on_client_response(m)
+
+    def _on_request_vote(self, m: RequestVote) -> None:
+        self._observe_term(m.term)
+        up_to_date = (m.last_log_term, m.last_log_index) >= (
+            self.state.term_at(self.state.last_index()),
+            self.state.last_index())
+        grant = (m.term == self.state.current_term and up_to_date
+                 and self.state.voted_for in (None, m.candidate))
+        if grant:
+            self.state.voted_for = m.candidate
+            self._election_deadline = self._new_election_timeout()
+        self._post(m.candidate, VoteResponse(self.state.current_term,
+                                             self.node_id, grant))
+
+    def _on_vote_response(self, m: VoteResponse) -> None:
+        self._observe_term(m.term)
+        if self.role == CANDIDATE and m.term == self.state.current_term and m.granted:
+            self._votes.add(m.voter)
+            self._maybe_win()
+
+    def _on_append(self, m: AppendEntries) -> None:
+        self._observe_term(m.term)
+        if m.term < self.state.current_term:
+            self._post(m.leader, AppendResponse(self.state.current_term,
+                                                self.node_id, False, 0))
+            return
+        self.role = FOLLOWER
+        self.leader_id = m.leader
+        self._election_deadline = self._new_election_timeout()
+        # consistency check at prev_log_index
+        if m.prev_log_index > self.state.last_index() or \
+                self.state.term_at(m.prev_log_index) != m.prev_log_term:
+            self._post(m.leader, AppendResponse(self.state.current_term,
+                                                self.node_id, False, 0))
+            return
+        # append / overwrite conflicting suffix
+        self.state.log = self.state.log[:m.prev_log_index] + list(m.entries)
+        if m.leader_commit > self.state.commit_index:
+            self.state.commit_index = min(m.leader_commit,
+                                          self.state.last_index())
+        self._apply_committed()
+        self._post(m.leader, AppendResponse(
+            self.state.current_term, self.node_id, True,
+            self.state.last_index()))
+
+    def _on_append_response(self, m: AppendResponse) -> None:
+        self._observe_term(m.term)
+        if self.role != LEADER or m.term != self.state.current_term:
+            return
+        if m.success:
+            self._match_index[m.follower] = m.match_index
+            self._next_index[m.follower] = m.match_index + 1
+            self._maybe_commit()
+        else:
+            self._next_index[m.follower] = max(
+                1, self._next_index.get(m.follower, 1) - 1)
+            self._send_append(m.follower)
+
+    def _maybe_commit(self) -> None:
+        n_nodes = len(self.peers) + 1
+        for idx in range(self.state.last_index(), self.state.commit_index, -1):
+            if self.state.term_at(idx) != self.state.current_term:
+                break  # only commit entries from the current term directly
+            replicated = 1 + sum(1 for p in self.peers
+                                 if self._match_index.get(p, 0) >= idx)
+            if replicated > n_nodes // 2:
+                self.state.commit_index = idx
+                break
+        self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        while self.state.last_applied < self.state.commit_index:
+            self.state.last_applied += 1
+            entry = self.state.log[self.state.last_applied - 1]
+            if entry.entry == NOOP:
+                continue
+            try:
+                result = self.apply_fn(entry.entry)
+                error = None
+            except Exception as e:
+                result, error = None, str(e)
+            if entry.client is not None and entry.request_id is not None:
+                resp = ClientResponse(entry.request_id, result, error)
+                if entry.client == self.node_id:
+                    self._resolve(resp)
+                elif self.role == LEADER:
+                    self._post(entry.client, resp)
+
+    def _on_client_response(self, m: ClientResponse) -> None:
+        self._resolve(m)
+
+    def _resolve(self, m: ClientResponse) -> None:
+        fut = self._pending.pop(m.request_id, None)
+        if fut is None:
+            return
+        if m.error is not None:
+            fut.set_exception(RaftApplyError(m.error))
+        else:
+            fut.set_result(m.result)
+
+
+class RaftApplyError(Exception):
+    pass

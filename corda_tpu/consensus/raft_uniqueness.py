@@ -1,0 +1,65 @@
+"""Raft-replicated notary commit log.
+
+Reference parity: RaftUniquenessProvider (node/services/transactions/
+RaftUniquenessProvider.kt:41,101-155) submitting `PutAll` commands to a
+replicated `DistributedImmutableMap` (DistributedImmutableMap.kt:1-120):
+put-if-absent of all input-state refs, reporting ALL conflicting entries,
+applied identically on every replica.
+"""
+from __future__ import annotations
+
+from ..node.notary import ConsumedStateDetails, UniquenessException, UniquenessProvider
+from .raft import RaftNode
+
+
+class DistributedImmutableMap:
+    """The replicated state machine: put-if-absent batches keyed by StateRef
+    (apply must be deterministic — identical on every replica)."""
+
+    def __init__(self):
+        self._map: dict = {}
+
+    def apply(self, command) -> dict:
+        from ..node.notary import find_conflicts, record_all
+        kind, payload = command
+        if kind != "put_all":
+            raise ValueError(f"unknown command {kind!r}")
+        tx_id, refs, caller = payload
+        conflicts = find_conflicts(self._map, refs, tx_id)
+        if conflicts:
+            return {"committed": False, "conflicts": conflicts}
+        record_all(self._map, refs, tx_id, caller)
+        return {"committed": True, "conflicts": {}}
+
+    def __len__(self):
+        return len(self._map)
+
+
+class RaftUniquenessProvider(UniquenessProvider):
+    """UniquenessProvider backed by a RaftNode; `commit` blocks on consensus
+    (CopycatClient.submit(PutAll).get() semantics)."""
+
+    def __init__(self, raft_node: RaftNode, timeout_s: float = 30.0):
+        self.raft = raft_node
+        self.timeout_s = timeout_s
+
+    @staticmethod
+    def build(node_id: str, peers: list[str], messaging,
+              state_machine: DistributedImmutableMap | None = None,
+              seed: int | None = None) -> "RaftUniquenessProvider":
+        sm = state_machine if state_machine is not None else DistributedImmutableMap()
+        raft = RaftNode(node_id, peers, messaging, sm.apply, seed=seed)
+        provider = RaftUniquenessProvider(raft)
+        provider.state_machine = sm
+        return provider
+
+    def commit(self, states, tx_id, caller: str) -> None:
+        import concurrent.futures
+        fut = self.raft.submit(("put_all", [tx_id, list(states), caller]))
+        try:
+            result = fut.result(timeout=self.timeout_s)
+        except concurrent.futures.TimeoutError:
+            self.raft.abandon(fut)  # don't leak the pending-request entry
+            raise
+        if not result["committed"]:
+            raise UniquenessException(result["conflicts"])

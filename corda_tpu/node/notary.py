@@ -47,6 +47,23 @@ class UniquenessProvider:
         raise NotImplementedError
 
 
+def find_conflicts(consumed_map: dict, states, tx_id) -> dict:
+    """All refs already consumed by a DIFFERENT transaction (re-notarising
+    the same tx is idempotent) — the shared check of every commit-log
+    backend (in-memory, file, replicated)."""
+    conflicts = {}
+    for ref in states:
+        prev = consumed_map.get(ref)
+        if prev is not None and prev.consuming_tx != tx_id:
+            conflicts[ref] = prev
+    return conflicts
+
+
+def record_all(consumed_map: dict, states, tx_id, caller: str) -> None:
+    for i, ref in enumerate(states):
+        consumed_map[ref] = ConsumedStateDetails(tx_id, i, caller)
+
+
 class InMemoryUniquenessProvider(UniquenessProvider):
     """ThreadBox'd map semantics of PersistentUniquenessProvider.kt:73-130:
     atomically check all inputs, record all or none, report ALL conflicts."""
@@ -57,15 +74,10 @@ class InMemoryUniquenessProvider(UniquenessProvider):
 
     def commit(self, states, tx_id, caller: str) -> None:
         with self._lock:
-            conflicts = {}
-            for i, ref in enumerate(states):
-                prev = self._consumed.get(ref)
-                if prev is not None and prev.consuming_tx != tx_id:
-                    conflicts[ref] = prev
+            conflicts = find_conflicts(self._consumed, states, tx_id)
             if conflicts:
                 raise UniquenessException(conflicts)
-            for i, ref in enumerate(states):
-                self._consumed[ref] = ConsumedStateDetails(tx_id, i, caller)
+            record_all(self._consumed, states, tx_id, caller)
 
     def __len__(self):
         with self._lock:
@@ -88,11 +100,7 @@ class FileUniquenessProvider(InMemoryUniquenessProvider):
 
     def commit(self, states, tx_id, caller: str) -> None:
         with self._lock:
-            conflicts = {}
-            for ref in states:
-                prev = self._consumed.get(ref)
-                if prev is not None and prev.consuming_tx != tx_id:
-                    conflicts[ref] = prev
+            conflicts = find_conflicts(self._consumed, states, tx_id)
             if conflicts:
                 raise UniquenessException(conflicts)
             with open(self.path, "ab") as f:
@@ -101,8 +109,7 @@ class FileUniquenessProvider(InMemoryUniquenessProvider):
                     f.write(serialize([ref, details]) + b"\n")
                 f.flush()
                 os.fsync(f.fileno())
-                for i, ref in enumerate(states):
-                    self._consumed[ref] = ConsumedStateDetails(tx_id, i, caller)
+                record_all(self._consumed, states, tx_id, caller)
 
 
 class TimeWindowChecker:

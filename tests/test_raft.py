@@ -1,0 +1,142 @@
+"""Raft consensus tests over the deterministic bus.
+
+Reference analogs: RaftNotaryServiceTests / DistributedImmutableMapTests —
+leader election, replicated commitment, leader-failure re-election,
+double-spend conflict reporting through the replicated map.
+"""
+import pytest
+
+from corda_tpu.consensus.raft import LEADER, FOLLOWER, RaftNode
+from corda_tpu.consensus.raft_uniqueness import (DistributedImmutableMap,
+                                                 RaftUniquenessProvider)
+from corda_tpu.core.contracts.structures import StateRef
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.network.inmemory import InMemoryMessagingNetwork
+from corda_tpu.node.notary import UniquenessException
+
+
+def make_cluster(n=3, applied=None):
+    bus = InMemoryMessagingNetwork()
+    names = [f"raft{i}" for i in range(n)]
+    nodes = []
+    for i, name in enumerate(names):
+        ep = bus.create_node(name)
+        store = [] if applied is None else applied[i]
+        nodes.append(RaftNode(name, list(names), ep,
+                              (lambda s: (lambda e: (s.append(e), len(s))[1]))(store),
+                              seed=i))
+    return bus, nodes
+
+
+def run_until_leader(bus, nodes, max_ticks=200):
+    for _ in range(max_ticks):
+        for node in nodes:
+            node.tick()
+        bus.run_network()
+        leaders = [n for n in nodes if n.role == LEADER]
+        if leaders:
+            # let heartbeats settle follower state
+            for _ in range(5):
+                for node in nodes:
+                    node.tick()
+                bus.run_network()
+            final = [n for n in nodes if n.role == LEADER]
+            if len(final) == 1:
+                return final[0]
+    raise AssertionError("no leader elected")
+
+
+def pump(bus, nodes, ticks=10):
+    for _ in range(ticks):
+        for node in nodes:
+            node.tick()
+        bus.run_network()
+
+
+def test_leader_election_and_replication():
+    applied = [[], [], []]
+    bus, nodes = make_cluster(3, applied)
+    leader = run_until_leader(bus, nodes)
+    fut = leader.submit("entry-1")
+    pump(bus, nodes)
+    assert fut.result(timeout=1) == 1
+    fut2 = leader.submit("entry-2")
+    pump(bus, nodes)
+    assert fut2.result(timeout=1) == 2
+    # every replica applied both entries in order
+    assert applied[0] == applied[1] == applied[2] == ["entry-1", "entry-2"]
+
+
+def test_follower_forwards_to_leader():
+    applied = [[], [], []]
+    bus, nodes = make_cluster(3, applied)
+    leader = run_until_leader(bus, nodes)
+    follower = next(n for n in nodes if n.role == FOLLOWER)
+    fut = follower.submit("via-follower")
+    pump(bus, nodes)
+    assert fut.result(timeout=1) == 1
+    assert all(a == ["via-follower"] for a in applied)
+
+
+def test_reelection_after_leader_death():
+    applied = [[], [], []]
+    bus, nodes = make_cluster(3, applied)
+    leader = run_until_leader(bus, nodes)
+    fut = leader.submit("pre-crash")
+    pump(bus, nodes)
+    fut.result(timeout=1)
+    # silence the leader: stop ticking it and drop its traffic
+    dead = leader
+    bus.transfer_filter = lambda t: t.sender != dead.node_id and \
+        t.recipient != dead.node_id
+    survivors = [n for n in nodes if n is not dead]
+    new_leader = run_until_leader(bus, survivors)
+    assert new_leader is not dead
+    fut2 = new_leader.submit("post-crash")
+    pump(bus, survivors)
+    assert fut2.result(timeout=1) == 2
+    surviving_logs = [applied[nodes.index(n)] for n in survivors]
+    assert all(a == ["pre-crash", "post-crash"] for a in surviving_logs)
+
+
+def test_raft_uniqueness_provider_conflicts():
+    bus = InMemoryMessagingNetwork()
+    names = [f"raft{i}" for i in range(3)]
+    shared_machines = [DistributedImmutableMap() for _ in range(3)]
+    providers = [RaftUniquenessProvider.build(
+        name, list(names), bus.create_node(name),
+        state_machine=shared_machines[i], seed=i)
+        for i, name in enumerate(names)]
+    nodes = [p.raft for p in providers]
+    leader = run_until_leader(bus, nodes)
+    leader_provider = providers[nodes.index(leader)]
+
+    ref = StateRef(SecureHash.sha256(b"issue-tx"), 0)
+    tx1 = SecureHash.sha256(b"spend-1")
+    tx2 = SecureHash.sha256(b"spend-2")
+
+    import threading
+    results = {}
+
+    def commit(key, tx_id):
+        try:
+            leader_provider.commit([ref], tx_id, "caller")
+            results[key] = "ok"
+        except UniquenessException as e:
+            results[key] = e.conflicts
+
+    t1 = threading.Thread(target=commit, args=("first", tx1))
+    t1.start()
+    pump(bus, nodes, 20)
+    t1.join(timeout=5)
+    assert results["first"] == "ok"
+
+    t2 = threading.Thread(target=commit, args=("second", tx2))
+    t2.start()
+    pump(bus, nodes, 20)
+    t2.join(timeout=5)
+    conflicts = results["second"]
+    assert conflicts != "ok" and ref in conflicts
+    assert conflicts[ref].consuming_tx == tx1
+    # replicas hold identical committed maps
+    assert all(len(m) == 1 for m in shared_machines)

@@ -1,0 +1,108 @@
+"""Scheduler + ProgressTracker tests (NodeSchedulerServiceTest /
+ProgressTracker tests analogs)."""
+import datetime
+
+import pytest
+
+from corda_tpu.core.contracts import Command, TransactionState
+from corda_tpu.core.contracts.structures import (SchedulableState,
+                                                 ScheduledActivity)
+from corda_tpu.core.serialization.codec import exact_epoch_micros
+from corda_tpu.core.transactions import WireTransaction
+from corda_tpu.flows import FlowLogic, startable_by_rpc
+from corda_tpu.node.scheduler import FlowLogicRefFactory, NodeSchedulerService
+from corda_tpu.testing import DummyContract, MockNetwork
+from corda_tpu.utils.progress import DONE, ProgressTracker, Step
+
+T0 = datetime.datetime(2026, 7, 30, 12, 0, tzinfo=datetime.timezone.utc)
+
+
+class FireFlow(FlowLogic):
+    def __init__(self, note):
+        self.note = note
+
+    def call(self):
+        return f"fired:{self.note}"
+
+
+class TimerState(SchedulableState):
+    def __init__(self, fire_at_micros: int, owners=()):
+        self.fire_at_micros = fire_at_micros
+        self.owners = tuple(owners)
+
+    @property
+    def contract(self):
+        from corda_tpu.testing.dummy import _DUMMY_CONTRACT
+        return _DUMMY_CONTRACT
+
+    @property
+    def participants(self):
+        return list(self.owners)
+
+    def next_scheduled_activity(self, ref, factory):
+        return ScheduledActivity(factory.create(FireFlow, "timer"),
+                                 self.fire_at_micros)
+
+    def __eq__(self, other):
+        return (isinstance(other, TimerState)
+                and other.fire_at_micros == self.fire_at_micros)
+
+    def __hash__(self):
+        return hash(self.fire_at_micros)
+
+
+from corda_tpu.core.serialization import register_type  # noqa: E402
+
+register_type("test.TimerState", TimerState,
+              to_fields=lambda s: [s.fire_at_micros, list(s.owners)],
+              from_fields=lambda f: TimerState(f[0], tuple(f[1])))
+
+
+def test_scheduler_fires_due_states():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    node = network.create_node("O=Sched, L=Oslo, C=NO")
+    network.start_nodes()
+    scheduler = NodeSchedulerService(node.services,
+                                     clock=lambda: T0)
+    scheduler.start()
+
+    fire_at = exact_epoch_micros(T0 + datetime.timedelta(minutes=10))
+    wtx = WireTransaction(
+        outputs=(TransactionState(
+            TimerState(fire_at, (node.party.owning_key,)), notary.party),),
+        commands=(Command(DummyContract.Create(), (node.party.owning_key,)),),
+        notary=notary.party, must_sign=(node.party.owning_key,))
+    stx = node.services.sign_initial_transaction(wtx)
+    node.services.record_transactions(stx)
+
+    assert scheduler.next_deadline_micros() == fire_at
+    # not due yet
+    assert scheduler.wake(T0) == []
+    # due now
+    started = scheduler.wake(T0 + datetime.timedelta(minutes=11))
+    network.run_network()
+    assert len(started) == 1
+    assert started[0].result_future.result(timeout=1) == "fired:timer"
+    assert scheduler.next_deadline_micros() is None
+
+
+def test_progress_tracker_hierarchy_and_stream():
+    FETCH = Step("Fetching")
+    VERIFY = Step("Verifying")
+    outer = ProgressTracker(FETCH, VERIFY)
+    inner = ProgressTracker(Step("Downloading"))
+    outer.set_child_progress_tracker(FETCH, inner)
+    events = []
+    outer.subscribe(events.append)
+
+    outer.next_step()
+    assert outer.current_step == FETCH
+    inner.next_step()
+    outer.current_step = VERIFY
+    outer.next_step()
+    assert outer.has_ended
+    kinds = [e[0] for e in events]
+    assert kinds.count("position") >= 4
+    rendered = ProgressTracker(FETCH, VERIFY).render()
+    assert "Fetching" in rendered and "Verifying" in rendered
