@@ -95,6 +95,34 @@ class FileCheckpointStorage(CheckpointStorage):
             pass
 
 
+class KvCheckpointStorage(CheckpointStorage):
+    """Checkpoints on the native kvlog engine (corda_tpu.storage): synced
+    crc-framed appends with torn-tail recovery — the DBCheckpointStorage
+    durability class without an embedded SQL database."""
+
+    def __init__(self, path: str, use_native: bool | None = None):
+        super().__init__()
+        from ..storage import KvStore
+        self._kv = KvStore(path, use_native=use_native)
+        for key, blob in self._kv.items():
+            cp = _checkpoint_from_bytes(blob)
+            self._checkpoints[cp.id] = cp
+
+    def add_checkpoint(self, cp: Checkpoint) -> None:
+        super().add_checkpoint(cp)
+        self._kv[cp.id.encode()] = _checkpoint_to_bytes(cp)
+
+    def remove_checkpoint(self, cp_or_id) -> None:
+        cp_id = cp_or_id if isinstance(cp_or_id, str) else cp_or_id.id
+        super().remove_checkpoint(cp_id)
+        key = cp_id.encode()
+        if key in self._kv:
+            del self._kv[key]
+
+    def close(self) -> None:
+        self._kv.close()
+
+
 def _checkpoint_to_bytes(cp: Checkpoint) -> bytes:
     return serialize([
         cp.run_id, cp.flow_class, cp.flow_fields, cp.response_log,

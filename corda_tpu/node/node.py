@@ -24,7 +24,7 @@ from ..network.messaging import TopicSession
 from ..network.netmap import NetworkMapClient, NetworkMapService
 from ..network.tcp import TcpMessagingService
 from ..utils.affinity import SerialExecutor
-from .checkpoints import FileCheckpointStorage
+from .checkpoints import FileCheckpointStorage  # noqa: F401 (public re-export)
 from .notary import (FileUniquenessProvider, SimpleNotaryService,
                      ValidatingNotaryService)
 from .rpc import CordaRPCOps
@@ -106,11 +106,18 @@ class Node:
                              advertised_services=services)
         self.services = ServiceHub(self.info, self.messaging,
                                    key_pairs=[self.key_pair])
+        # durable storage on the kvlog engine (native C++ when built, the
+        # format-identical Python engine otherwise) — transactions AND
+        # checkpoints persist together, or resumed flows would reference
+        # transactions a restart forgot
+        from .checkpoints import KvCheckpointStorage
+        from .services import DurableTransactionStorage
+        self.services.storage = DurableTransactionStorage(
+            os.path.join(config.base_directory, "transactions.kv"))
+        checkpoint_storage = KvCheckpointStorage(
+            os.path.join(config.base_directory, "checkpoints.kv"))
         self.services.verifier_service = self._make_verifier()
-        self.smm = StateMachineManager(
-            self.services,
-            FileCheckpointStorage(os.path.join(config.base_directory,
-                                               "checkpoints")))
+        self.smm = StateMachineManager(self.services, checkpoint_storage)
         self.services.smm = self.smm
         install_core_flows(self.smm)
         self.notary_service = self._make_notary()
@@ -208,6 +215,10 @@ class Node:
         self.smm.stop()
         self.messaging.stop()
         self.executor.shutdown()
+        for store in (self.smm.checkpoints, self.services.storage):
+            close = getattr(store, "close", None)
+            if close is not None:
+                close()
 
     # -- RPC server ----------------------------------------------------------
     def _on_rpc(self, msg) -> None:

@@ -117,6 +117,34 @@ class TransactionStorage:
             return list(self._txs.values())
 
 
+class DurableTransactionStorage(TransactionStorage):
+    """Validated-tx store persisted on the kvlog engine (DBTransactionStorage
+    role): canonical-codec blobs keyed by tx id, replayed at open."""
+
+    def __init__(self, path: str, use_native: bool | None = None):
+        super().__init__()
+        from ..core.serialization import deserialize, serialize
+        from ..storage import KvStore
+        self._serialize = serialize
+        self._kv = KvStore(path, use_native=use_native)
+        for key, blob in self._kv.items():
+            stx = deserialize(blob)
+            self._txs[stx.id] = stx
+
+    def add_transaction(self, stx, notify: bool = True) -> bool:
+        with self._lock:
+            fresh = stx.id not in self._txs
+            if fresh:
+                self._kv[stx.id.bytes] = self._serialize(stx)
+                self._txs[stx.id] = stx
+        if fresh and notify:
+            self.notify_listeners(stx)
+        return fresh
+
+    def close(self) -> None:
+        self._kv.close()
+
+
 class KeyManagementService:
     """Signing keys + fresh-key generation
     (PersistentKeyManagementService / E2ETestKeyManagementService analog)."""

@@ -1,0 +1,181 @@
+// kvlog — append-only key/value log storage engine with crash recovery.
+//
+// The native storage core under the node's persistence layer (the role H2 +
+// JDBCHashMap play in the reference: node/utilities/JDBCHashMap.kt,
+// DBCheckpointStorage, DBTransactionStorage). Design:
+//
+//   - One append-only data file. Records: [u32 crc][u32 klen][u32 vlen]
+//     [key][value]; vlen == 0xFFFFFFFF marks a tombstone (delete).
+//   - The in-memory index (key -> offset,len) is owned by the Python side;
+//     this engine exposes sequential scan for recovery plus append/read.
+//   - Appends are synced (fdatasync) before returning — a record returned as
+//     written survives a crash; torn tail records are detected by CRC and
+//     truncated on recovery (the WAL discipline the reference gets from H2).
+//
+// C ABI for ctypes (no pybind11 dependency).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+
+#include <fcntl.h>
+#include <unistd.h>
+#include <sys/stat.h>
+
+namespace {
+
+uint32_t crc32_table[256];
+bool crc_ready = false;
+
+void crc_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc32_table[i] = c;
+    }
+    crc_ready = true;
+}
+
+uint32_t crc32(const uint8_t* data, size_t n, uint32_t seed = 0) {
+    if (!crc_ready) crc_init();
+    uint32_t c = seed ^ 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; i++)
+        c = crc32_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+struct KvLog {
+    int fd = -1;
+    uint64_t size = 0;   // logical end (past last valid record)
+};
+
+constexpr uint32_t TOMBSTONE = 0xFFFFFFFFu;
+
+void put_u32(uint8_t* p, uint32_t v) {
+    p[0] = uint8_t(v >> 24); p[1] = uint8_t(v >> 16);
+    p[2] = uint8_t(v >> 8);  p[3] = uint8_t(v);
+}
+
+uint32_t get_u32(const uint8_t* p) {
+    return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+           (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open (creating if needed). Returns handle or null.
+KvLog* kvlog_open(const char* path) {
+    int fd = ::open(path, O_RDWR | O_CREAT, 0644);
+    if (fd < 0) return nullptr;
+    auto* log = new KvLog();
+    log->fd = fd;
+    struct stat st{};
+    if (fstat(fd, &st) == 0) log->size = uint64_t(st.st_size);
+    return log;
+}
+
+void kvlog_close(KvLog* log) {
+    if (!log) return;
+    if (log->fd >= 0) ::close(log->fd);
+    delete log;
+}
+
+// Append one record; returns the record's offset, or -1 on error.
+// vlen == TOMBSTONE (pass tombstone=1, value ignored) marks deletion.
+int64_t kvlog_append(KvLog* log, const uint8_t* key, uint32_t klen,
+                     const uint8_t* value, uint32_t vlen, int tombstone) {
+    if (!log || log->fd < 0) return -1;
+    if (tombstone) vlen = TOMBSTONE;
+    const uint32_t body_vlen = tombstone ? 0 : vlen;
+    const uint64_t total = 12ull + klen + body_vlen;
+    uint8_t* buf = static_cast<uint8_t*>(malloc(total));
+    if (!buf) return -1;
+    put_u32(buf + 4, klen);
+    put_u32(buf + 8, vlen);
+    memcpy(buf + 12, key, klen);
+    if (body_vlen) memcpy(buf + 12 + klen, value, body_vlen);
+    uint32_t crc = crc32(buf + 4, total - 4);
+    put_u32(buf, crc);
+
+    const int64_t offset = int64_t(log->size);
+    uint64_t written = 0;
+    while (written < total) {
+        ssize_t n = pwrite(log->fd, buf + written, total - written,
+                           off_t(log->size + written));
+        if (n <= 0) { free(buf); return -1; }
+        written += uint64_t(n);
+    }
+    free(buf);
+    // Advance size BEFORE the sync: if the sync fails the record may or may
+    // not be durable, so the offset must never be reused (a later append
+    // overwriting it could resurrect-or-destroy ambiguously). -2 signals
+    // "written but durability unknown" — callers must fail stop.
+    log->size += total;
+#if defined(__APPLE__)
+    if (fsync(log->fd) != 0) return -2;
+#else
+    if (fdatasync(log->fd) != 0) return -2;
+#endif
+    return offset;
+}
+
+// Read the record at `offset`. Fills key/value lengths and copies up to the
+// provided capacities. Returns: 1 = value record, 2 = tombstone, 0 = end/
+// truncated-or-corrupt tail, -1 = error. `next_offset` receives the offset
+// of the following record on success.
+int kvlog_read_at(KvLog* log, int64_t offset,
+                  uint8_t* key_buf, uint32_t key_cap, uint32_t* klen_out,
+                  uint8_t* val_buf, uint32_t val_cap, uint32_t* vlen_out,
+                  int64_t* next_offset) {
+    if (!log || log->fd < 0 || offset < 0) return -1;
+    if (uint64_t(offset) + 12 > log->size) return 0;
+    uint8_t header[12];
+    if (pread(log->fd, header, 12, off_t(offset)) != 12) return 0;
+    const uint32_t crc = get_u32(header);
+    const uint32_t klen = get_u32(header + 4);
+    const uint32_t vlen = get_u32(header + 8);
+    const bool tomb = (vlen == TOMBSTONE);
+    const uint32_t body_vlen = tomb ? 0 : vlen;
+    if (klen > (64u << 20) || body_vlen > (1u << 30)) return 0;
+    const uint64_t total = 12ull + klen + body_vlen;
+    if (uint64_t(offset) + total > log->size) return 0;
+
+    uint8_t* body = static_cast<uint8_t*>(malloc(8 + klen + body_vlen));
+    if (!body) return -1;
+    memcpy(body, header + 4, 8);
+    if (pread(log->fd, body + 8, klen + body_vlen,
+              off_t(offset) + 12) != ssize_t(klen + body_vlen)) {
+        free(body); return 0;
+    }
+    if (crc32(body, 8 + klen + body_vlen) != crc) { free(body); return 0; }
+
+    *klen_out = klen;
+    *vlen_out = body_vlen;
+    if (key_cap < klen || (!tomb && val_cap < body_vlen)) {
+        free(body);
+        return -3;  // caller's buffers too small — never silently truncate
+    }
+    memcpy(key_buf, body + 8, klen);
+    if (!tomb) memcpy(val_buf, body + 8 + klen, body_vlen);
+    free(body);
+    if (next_offset) *next_offset = offset + int64_t(total);
+    return tomb ? 2 : 1;
+}
+
+// Truncate any torn tail found at `offset` (first invalid record position).
+int kvlog_truncate(KvLog* log, int64_t offset) {
+    if (!log || log->fd < 0 || offset < 0) return -1;
+    if (ftruncate(log->fd, off_t(offset)) != 0) return -1;
+    log->size = uint64_t(offset);
+    return 0;
+}
+
+int64_t kvlog_size(KvLog* log) {
+    return log ? int64_t(log->size) : -1;
+}
+
+}  // extern "C"
