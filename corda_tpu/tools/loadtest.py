@@ -1,0 +1,182 @@
+"""Load-test harness: command generation, execution, state gathering,
+invariant checking, and fault injection.
+
+Reference parity: tools/loadtest (LoadTest.kt:1-211 — the generate /
+interpret / execute / gatherRemoteState test shape), tests/
+{SelfIssueTest,CrossCashTest}.kt, and Disruption.kt:17-105 (kill/restart
+nodes, message-drop windows) — here driven against MockNetwork for
+deterministic volume or the process driver for real clusters.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..core.contracts.amount import Amount, USD
+from ..finance import CashIssueFlow, CashPaymentFlow, CashState
+
+
+@dataclass
+class LoadTest:
+    """One scenario: generate commands from the model state, execute them,
+    gather the remote state, check the invariant (LoadTest.kt's type)."""
+
+    name: str
+    generate: Callable[[Any, random.Random], list]
+    execute: Callable[[Any, Any], None]          # (nodes_ctx, command)
+    gather: Callable[[Any], Any]                  # nodes_ctx -> observed
+    check: Callable[[Any, Any], None]             # (model, observed) raises
+
+
+class Disruption:
+    """Fault injection applied for a window of iterations
+    (Disruption.kt analogs)."""
+
+    name = "noop"
+
+    def apply(self, ctx) -> None:  # pragma: no cover - interface
+        pass
+
+    def restore(self, ctx) -> None:  # pragma: no cover - interface
+        pass
+
+
+class KillRestartNode(Disruption):
+    """Kill a node mid-run and restart it from its checkpoints
+    (Disruption.kt's nodeKill + restart via SSH, MockNetwork edition)."""
+
+    def __init__(self, pick: Callable[[Any], Any]):
+        self.pick = pick
+        self.name = "kill-restart-node"
+
+    def apply(self, ctx) -> None:
+        node = self.pick(ctx)
+        restarted = node.restart()
+        restarted.start()
+        for key in ("nodes", "party_nodes"):
+            seq = ctx.get(key)
+            if seq and node in seq:
+                seq[seq.index(node)] = restarted
+
+    def restore(self, ctx) -> None:
+        pass  # the restart IS the recovery
+
+
+class DropMessages(Disruption):
+    """Drop a fraction of bus transfers for the window (network flakiness)."""
+
+    def __init__(self, fraction: float, seed: int = 0):
+        self.fraction = fraction
+        self.name = f"drop-{fraction}"
+        self._rng = random.Random(seed)
+
+    def apply(self, ctx) -> None:
+        net = ctx["network"]
+        self._old = net.bus.transfer_filter
+        net.bus.transfer_filter = \
+            lambda t: self._rng.random() >= self.fraction
+
+    def restore(self, ctx) -> None:
+        ctx["network"].bus.transfer_filter = self._old
+
+
+def run_load_test(test: LoadTest, ctx, iterations: int, seed: int = 0,
+                  disruptions: list[tuple[int, int, Disruption]] = ()) -> Any:
+    """Run `iterations` rounds; each round generates commands from the model,
+    executes them, pumps the network, and (at the end) checks invariants.
+    `disruptions` = [(start_iter, end_iter, disruption)]."""
+    rng = random.Random(seed)
+    model: dict = {"issued": {}, "paid": []}
+    active: list[Disruption] = []
+    for it in range(iterations):
+        for start, end, d in disruptions:
+            if it == start:
+                d.apply(ctx)
+                active.append(d)
+            if it == end and d in active:
+                d.restore(ctx)
+                active.remove(d)
+        for command in test.generate(model, rng):
+            test.execute(ctx, command)
+        ctx["network"].run_network()
+    for d in active:
+        d.restore(ctx)
+    ctx["network"].run_network()
+    observed = test.gather(ctx)
+    test.check(model, observed)
+    return observed
+
+
+# ---------------------------------------------------------------------------
+# The standard scenarios (SelfIssueTest / CrossCashTest analogs)
+# ---------------------------------------------------------------------------
+
+def self_issue_test() -> LoadTest:
+    """Nodes repeatedly self-issue cash; the invariant is that every node's
+    vault total equals the model's issued total (SelfIssueTest.kt)."""
+
+    def generate(model, rng):
+        return [("issue", rng.randrange(0, 3), rng.randint(1, 500) * 100)]
+
+    def execute(ctx, command):
+        _, node_idx, quantity = command
+        node = ctx["party_nodes"][node_idx]
+        notary = ctx["notary"]
+        fsm = node.start_flow(CashIssueFlow(
+            Amount(quantity, USD), b"\x01", node.party, notary.party))
+        ctx.setdefault("flows", []).append(fsm)
+        ctx["model_issued"] = ctx.get("model_issued", {})
+        ctx["model_issued"][node_idx] = \
+            ctx["model_issued"].get(node_idx, 0) + quantity
+
+    def gather(ctx):
+        totals = {}
+        for i, node in enumerate(ctx["party_nodes"]):
+            totals[i] = sum(s.state.data.amount.quantity
+                            for s in node.services.vault.unconsumed_states(CashState))
+        return totals
+
+    def check(model, observed):
+        pass  # the caller compares against ctx["model_issued"]
+
+    return LoadTest("SelfIssue", generate, execute, gather, check)
+
+
+def cross_cash_test() -> LoadTest:
+    """Nodes issue and pay each other; the invariant is conservation: the sum
+    of all vault holdings equals the total issued (CrossCashTest.kt)."""
+
+    def generate(model, rng):
+        cmds = []
+        if rng.random() < 0.5:
+            cmds.append(("issue", rng.randrange(0, 3),
+                         rng.randint(1, 500) * 100))
+        if rng.random() < 0.6:
+            a, b = rng.sample(range(3), 2)
+            cmds.append(("pay", a, b, rng.randint(1, 50) * 100))
+        return cmds
+
+    def execute(ctx, command):
+        nodes = ctx["party_nodes"]
+        if command[0] == "issue":
+            _, i, quantity = command
+            fsm = nodes[i].start_flow(CashIssueFlow(
+                Amount(quantity, USD), b"\x01", nodes[i].party,
+                ctx["notary"].party))
+            ctx["total_issued"] = ctx.get("total_issued", 0) + quantity
+        else:
+            _, a, b, quantity = command
+            fsm = nodes[a].start_flow(CashPaymentFlow(
+                Amount(quantity, USD), nodes[b].party))
+        ctx.setdefault("flows", []).append(fsm)
+
+    def gather(ctx):
+        return sum(s.state.data.amount.quantity
+                   for node in ctx["party_nodes"]
+                   for s in node.services.vault.unconsumed_states(CashState))
+
+    def check(model, observed):
+        pass  # caller compares against ctx["total_issued"]
+
+    return LoadTest("CrossCash", generate, execute, gather, check)

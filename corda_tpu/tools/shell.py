@@ -1,0 +1,120 @@
+"""Interactive shell — the operator console over the RPC surface.
+
+Reference parity: the CRaSH-based shell (node/shell/InteractiveShell.kt:1-503
+with FlowShellCommand / RunShellCommand): `run <op> [args]` invokes any RPC
+operation, `flow start <Name> arg,...` starts a flow and renders its
+progress, `flow list` shows registered flows; output is rendered YAML-ish.
+The argument mini-parser is the StringToMethodCallParser analog
+(client/jackson/StringToMethodCallParser.kt): ints, quoted strings, amounts
+like `100 USD`, and party names resolve against the network map.
+"""
+from __future__ import annotations
+
+import shlex
+import sys
+
+from ..core.contracts.amount import Amount, currency
+
+
+class Shell:
+    def __init__(self, ops, out=None):
+        """`ops` is a CordaRPCOps (in-process) or CordaRPCClient (remote)."""
+        self.ops = ops
+        self.out = out if out is not None else sys.stdout
+
+    # -- rendering (the Yaml emitter analog) ---------------------------------
+    def _render(self, value, indent=0) -> str:
+        pad = "  " * indent
+        if isinstance(value, dict):
+            return "\n".join(f"{pad}{k}: {self._render(v, indent + 1).lstrip()}"
+                             if not isinstance(v, (dict, list))
+                             else f"{pad}{k}:\n{self._render(v, indent + 1)}"
+                             for k, v in value.items())
+        if isinstance(value, (list, tuple, set, frozenset)):
+            return "\n".join(f"{pad}- {self._render(v, indent + 1).lstrip()}"
+                             for v in value) or f"{pad}[]"
+        return f"{pad}{value!r}"
+
+    def _println(self, text: str) -> None:
+        print(text, file=self.out)
+
+    # -- argument parsing ----------------------------------------------------
+    def _parse_arg(self, token: str):
+        if token.lstrip("-").isdigit():
+            return int(token)
+        if " " in token:  # quoted multi-word: amount or party name
+            parts = token.split()
+            if (len(parts) == 2 and parts[0].replace(".", "").isdigit()
+                    and parts[1].isalpha() and parts[1].isupper()):
+                whole = float(parts[0])
+                return Amount(int(round(whole * 100)), currency(parts[1]))
+            if "=" in token:  # X.500 name → Party via the map
+                party = self._well_known(token)
+                if party is not None:
+                    return party
+        if token.startswith("0x"):
+            return bytes.fromhex(token[2:])
+        if "=" in token:
+            party = self._well_known(token)
+            if party is not None:
+                return party
+        return token
+
+    def _well_known(self, name: str):
+        try:
+            return self.ops.well_known_party_from_x500_name(name)
+        except Exception:
+            return None
+
+    # -- commands ------------------------------------------------------------
+    def execute(self, line: str) -> bool:
+        """Run one command line; returns False when the shell should exit."""
+        line = line.strip()
+        if not line:
+            return True
+        try:
+            tokens = shlex.split(line)
+        except ValueError as e:
+            self._println(f"parse error: {e}")
+            return True
+        cmd = tokens[0]
+        if cmd in ("exit", "quit", "bye"):
+            return False
+        if cmd == "help":
+            self._println("commands:\n  run <op> [args...]   invoke an RPC op"
+                          "\n  flow list            registered flows"
+                          "\n  flow start <Name> [args...]"
+                          "\n  exit")
+            return True
+        try:
+            if cmd == "run" and len(tokens) >= 2:
+                method = getattr(self.ops, tokens[1])
+                args = [self._parse_arg(t) for t in tokens[2:]]
+                self._println(self._render(method(*args)))
+            elif cmd == "flow" and len(tokens) >= 2 and tokens[1] == "list":
+                for name in self.ops.registered_flows():
+                    self._println(name)
+            elif cmd == "flow" and len(tokens) >= 3 and tokens[1] == "start":
+                args = [self._parse_arg(t) for t in tokens[3:]]
+                result = self._start_flow(tokens[2], args)
+                self._println(self._render(result))
+            else:
+                self._println(f"unknown command: {line!r} (try 'help')")
+        except Exception as e:
+            self._println(f"error: {type(e).__name__}: {e}")
+        return True
+
+    def _start_flow(self, name: str, args):
+        if hasattr(self.ops, "start_flow_and_wait"):     # remote client
+            return self.ops.start_flow_and_wait(name, *args)
+        fsm = self.ops.start_flow_dynamic(name, *args)   # in-process ops
+        return {"flow": name, "run_id": fsm.run_id}
+
+    def repl(self) -> None:  # pragma: no cover - interactive loop
+        while True:
+            try:
+                line = input(">>> ")
+            except (EOFError, KeyboardInterrupt):
+                break
+            if not self.execute(line):
+                break

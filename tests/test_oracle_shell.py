@@ -1,0 +1,113 @@
+"""Oracle tear-off attestation + shell tests.
+
+Reference analogs: NodeInterestRatesTest (oracle signs correct tear-offs,
+refuses wrong/overshared ones) and InteractiveShell command tests.
+"""
+import io
+
+import pytest
+
+import corda_tpu.finance  # noqa: F401 — registers the @startable_by_rpc flows
+from corda_tpu.core.contracts import Command, TransactionState
+from corda_tpu.core.transactions import WireTransaction
+from corda_tpu.flows import FlowException
+from corda_tpu.samples.rates_oracle import (Fix, FixOf, RatesFixQueryFlow,
+                                            RatesFixSignFlow, RatesOracle)
+from corda_tpu.testing import DummyContract, DummyState, MockNetwork
+from corda_tpu.tools.shell import Shell
+
+LIBOR_3M = FixOf("LIBOR", "2026-07-30", "3M")
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    oracle_node = network.create_node("O=Rates Oracle, L=London, C=GB")
+    alice = network.create_node("O=Alice, L=Madrid, C=ES")
+    network.start_nodes()
+    oracle = RatesOracle(oracle_node.services, {LIBOR_3M: 525})  # 5.25%
+    oracle.install(oracle_node.smm)
+    return network, notary, oracle_node, alice
+
+
+def make_wtx_with_fix(alice, oracle_node, notary, value_bp):
+    return WireTransaction(
+        outputs=(TransactionState(DummyState(1, (alice.party.owning_key,)),
+                                  notary.party),),
+        commands=(
+            Command(DummyContract.Create(), (alice.party.owning_key,)),
+            Command(Fix(LIBOR_3M, value_bp), (oracle_node.party.owning_key,)),
+        ),
+        notary=notary.party,
+        must_sign=(alice.party.owning_key, oracle_node.party.owning_key))
+
+
+def test_oracle_query_and_tear_off_sign(net):
+    network, notary, oracle_node, alice = net
+    # query the fix
+    fsm = alice.start_flow(RatesFixQueryFlow(oracle_node.party, LIBOR_3M))
+    network.run_network()
+    fix = fsm.result_future.result(timeout=1)
+    assert fix.value_bp == 525
+
+    # embed it, tear off everything except the oracle's command, get the sig
+    wtx = make_wtx_with_fix(alice, oracle_node, notary, fix.value_bp)
+    ftx = wtx.build_filtered_transaction(
+        lambda c: isinstance(c, Command) and isinstance(c.value, Fix))
+    assert ftx.verify()
+    # the torn form reveals ONE component (privacy) but proves the same id
+    assert len(ftx.filtered_leaves.available_components) == 1
+    assert ftx.root_hash == wtx.id
+
+    fsm = alice.start_flow(RatesFixSignFlow(oracle_node.party, ftx))
+    network.run_network()
+    sig = fsm.result_future.result(timeout=1)
+    assert sig.by == oracle_node.party.owning_key
+    sig.verify(wtx.id.bytes)  # the sig covers the FULL transaction id
+
+
+def test_oracle_refuses_wrong_rate_and_overshare(net):
+    network, notary, oracle_node, alice = net
+    # wrong rate embedded
+    wtx = make_wtx_with_fix(alice, oracle_node, notary, 999)
+    ftx = wtx.build_filtered_transaction(
+        lambda c: isinstance(c, Command) and isinstance(c.value, Fix))
+    fsm = alice.start_flow(RatesFixSignFlow(oracle_node.party, ftx))
+    network.run_network()
+    with pytest.raises(FlowException, match="refuses"):
+        fsm.result_future.result(timeout=1)
+
+    # overshared tear-off (reveals a non-Fix component) also refused
+    wtx2 = make_wtx_with_fix(alice, oracle_node, notary, 525)
+    ftx2 = wtx2.build_filtered_transaction(lambda c: True)  # reveal all
+    fsm = alice.start_flow(RatesFixSignFlow(oracle_node.party, ftx2))
+    network.run_network()
+    with pytest.raises(FlowException, match="refuses"):
+        fsm.result_future.result(timeout=1)
+
+
+def test_shell_commands(net):
+    network, notary, oracle_node, alice = net
+    from corda_tpu.node.rpc import CordaRPCOps
+    ops = CordaRPCOps(alice.services, alice.smm)
+    out = io.StringIO()
+    shell = Shell(ops, out=out)
+    assert shell.execute("flow list")
+    assert "CashIssueFlow" in out.getvalue()
+    assert shell.execute("run notary_identities")
+    assert "Notary" in out.getvalue()
+    assert shell.execute("run registered_flows")
+    assert shell.execute("bogus command")
+    assert "unknown command" in out.getvalue()
+    assert shell.execute("run nonexistent_op")
+    assert "error" in out.getvalue()
+    # flow start via the shell: issue cash with parsed Amount + Party args
+    assert shell.execute(
+        'flow start CashIssueFlow "100 USD" 0x01 '
+        '"O=Alice, L=Madrid, C=ES" "O=Notary Service, L=Zurich, C=CH"')
+    network.run_network()
+    assert "run_id" in out.getvalue()
+    from corda_tpu.finance import CashState
+    assert alice.services.vault.unconsumed_states(CashState)
+    assert not shell.execute("exit")
