@@ -38,6 +38,11 @@ class FetchTransactionsRequest:
 
 
 @dataclass(frozen=True)
+class FetchAttachmentsRequest:
+    att_ids: tuple           # SecureHash...
+
+
+@dataclass(frozen=True)
 class NotifyTxRequest:
     stx: Any
 
@@ -47,8 +52,8 @@ class SignTransactionRequest:
     stx: Any
 
 
-for _cls in (NotarisationRequest, FetchTransactionsRequest, NotifyTxRequest,
-             SignTransactionRequest):
+for _cls in (NotarisationRequest, FetchTransactionsRequest,
+             FetchAttachmentsRequest, NotifyTxRequest, SignTransactionRequest):
     register_type(f"flows.{_cls.__name__}", _cls)
 
 
@@ -181,6 +186,55 @@ class FetchTransactionsHandler(FlowLogic):
 
 
 @initiating_flow
+class FetchAttachmentsFlow(FlowLogic):
+    """Download attachments by hash from a peer, verifying content hashes
+    (FetchAttachmentsFlow: the hash IS the id, so tampering is detectable)."""
+
+    def __init__(self, peer, att_ids):
+        self.peer = peer
+        self.att_ids = tuple(att_ids)
+
+    def call(self):
+        hub = self.service_hub
+        to_fetch = [a for a in self.att_ids if not hub.attachments.has_attachment(a)]
+        if to_fetch:
+            resp = yield SendAndReceive(
+                self.peer, FetchAttachmentsRequest(tuple(to_fetch)), list)
+
+            def validate(blobs):
+                if len(blobs) != len(to_fetch):
+                    raise FlowException("Peer returned wrong attachment count")
+                from ..core.crypto.secure_hash import SecureHash
+                for att_id, blob in zip(to_fetch, blobs):
+                    if SecureHash.sha256(blob) != att_id:
+                        raise FlowException(
+                            f"Attachment content does not hash to {att_id}")
+                return blobs
+
+            for blob in resp.unwrap(validate):
+                hub.attachments.import_attachment(blob)
+        return [hub.attachments.open_attachment(a) for a in self.att_ids]
+
+
+class FetchAttachmentsHandler(FlowLogic):
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        req = yield Receive(self.peer, FetchAttachmentsRequest)
+        att_ids = req.unwrap(lambda r: r.att_ids)
+        hub = self.service_hub
+        blobs = []
+        for att_id in att_ids:
+            att = hub.attachments.open_attachment(att_id)
+            if att is None:
+                raise FlowException(f"Attachment {att_id} not found")
+            blobs.append(att.data)
+        yield Send(self.peer, blobs)
+        return None
+
+
+@initiating_flow
 class ResolveTransactionsFlow(FlowLogic):
     """Breadth-first dependency download + topological verify+record
     (ResolveTransactionsFlow.kt:31-134): walks stx.inputs' txhashes back,
@@ -217,6 +271,15 @@ class ResolveTransactionsFlow(FlowLogic):
                         seen.add(dep)
                         if hub.storage.get_transaction(dep) is None:
                             queue.append(dep)
+        # attachments referenced anywhere in the resolved set must be local
+        # before verification can open them (FetchAttachmentsFlow leg of
+        # ResolveTransactionsFlow.kt)
+        att_ids = {a for stx in fetched.values() for a in stx.tx.attachments}
+        if self.stx is not None:
+            att_ids |= set(self.stx.tx.attachments)
+        missing = [a for a in att_ids if not hub.attachments.has_attachment(a)]
+        if missing:
+            yield from self.sub_flow(FetchAttachmentsFlow(self.peer, missing))
         # topological order: dependencies before dependents
         order = _topological_order(fetched)
         for stx in order:
@@ -373,6 +436,8 @@ def install_core_flows(smm) -> None:
     from .api import flow_name
     smm.register_flow_factory(flow_name(FetchTransactionsFlow),
                               FetchTransactionsHandler)
+    smm.register_flow_factory(flow_name(FetchAttachmentsFlow),
+                              FetchAttachmentsHandler)
     smm.register_flow_factory(flow_name(BroadcastTransactionFlow),
                               NotifyTransactionHandler)
 

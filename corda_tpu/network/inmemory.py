@@ -65,20 +65,27 @@ class InMemoryMessagingNetwork:
     def pump_receive(self, recipient: str) -> MessageTransfer | None:
         """Deliver ONE pending message to `recipient` (pumpReceive analog)."""
         q = self._queues[recipient]
-        if not q:
+        try:
+            transfer = q.popleft()
+        except IndexError:
+            # empty — including the check-then-pop race when a second thread
+            # pumps a disjoint endpoint set (the raft demo's background pump)
             return None
-        transfer = q.popleft()
         self.delivered_log.append(transfer)
         self._endpoints[recipient]._deliver(transfer)
         return transfer
 
-    def run_network(self, rounds: int = -1) -> int:
+    def run_network(self, rounds: int = -1, exclude=()) -> int:
         """Pump all queues until quiescent (or `rounds` pumps). Returns the
-        number of messages delivered (MockNetwork.runNetwork analog)."""
+        number of messages delivered (MockNetwork.runNetwork analog).
+        `exclude` skips endpoints another thread owns."""
         delivered = 0
+        excluded = set(exclude)
         while rounds != 0:
             progressed = False
             for name in list(self._queues):
+                if name in excluded:
+                    continue
                 if self.pump_receive(name) is not None:
                     delivered += 1
                     progressed = True

@@ -122,5 +122,5 @@ class MockNetwork:
         for node in self.nodes:
             node.start()
 
-    def run_network(self, rounds: int = -1) -> int:
-        return self.bus.run_network(rounds)
+    def run_network(self, rounds: int = -1, exclude=()) -> int:
+        return self.bus.run_network(rounds, exclude=exclude)

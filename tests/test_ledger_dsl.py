@@ -1,0 +1,56 @@
+"""Ledger DSL self-tests, used to re-express Cash rules declaratively
+(the TestDSL usage pattern of CashTests.kt)."""
+import pytest
+
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.core.contracts.structures import Issued, PartyAndReference
+from corda_tpu.core.crypto import generate_keypair
+from corda_tpu.core.identity import Party
+from corda_tpu.finance.cash import Cash, CashState
+from corda_tpu.testing import DummyContract, DummyState
+from corda_tpu.testing.ledger_dsl import DSLFailure, ledger
+
+NOTARY = Party("O=Notary, L=Zurich, C=CH",
+               generate_keypair(entropy=b"\x71" * 32).public)
+BANK_KP = generate_keypair(entropy=b"\x72" * 32)
+BANK = Party("O=Bank, L=London, C=GB", BANK_KP.public)
+ALICE_KP = generate_keypair(entropy=b"\x73" * 32)
+TOKEN = Issued(PartyAndReference(BANK, b"\x01"), USD)
+
+
+def test_cash_lifecycle_via_dsl():
+    with ledger(NOTARY) as l:
+        with l.transaction() as tx:
+            tx.output("bank cash", CashState(Amount(10000, TOKEN),
+                                             BANK_KP.public))
+            tx.command(Cash.Issue(), BANK_KP.public)
+            tx.verifies()
+        with l.transaction() as tx:
+            tx.input("bank cash")
+            tx.output("alice cash", CashState(Amount(10000, TOKEN),
+                                              ALICE_KP.public))
+            tx.command(Cash.Move(), BANK_KP.public)
+            tx.verifies()
+        # a non-conserving move is rejected with the clause's message
+        with l.transaction() as tx:
+            tx.input("alice cash")
+            tx.output(None, CashState(Amount(900, TOKEN), BANK_KP.public))
+            tx.command(Cash.Move(), ALICE_KP.public)
+            tx.fails_with("conserved")
+    assert len(l.transactions) == 2
+
+
+def test_dsl_asserts_on_wrong_expectation():
+    with ledger(NOTARY) as l:
+        with pytest.raises(DSLFailure, match="but it passed"):
+            with l.transaction() as tx:
+                tx.output(None, DummyState(1, (ALICE_KP.public,)))
+                tx.command(DummyContract.Create(), ALICE_KP.public)
+                tx.fails_with("anything")
+
+
+def test_unasserted_transaction_is_auto_verified():
+    with pytest.raises(Exception):  # missing signer caught at block exit
+        with ledger(NOTARY) as l:
+            with l.transaction() as tx:
+                tx.input("nope")  # unknown label

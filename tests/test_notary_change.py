@@ -1,0 +1,75 @@
+"""Notary-change flow tests (NotaryChangeTests.kt analog): two-participant
+state migrates from notary A to notary B with everyone's consent; tampered
+proposals are refused."""
+import pytest
+
+from corda_tpu.core.contracts import Command, TransactionState
+from corda_tpu.core.contracts.structures import StateAndRef, StateRef
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.flows.library import FinalityFlow
+from corda_tpu.flows.state_replacement import (NotaryChangeFlow,
+                                               StateReplacementException,
+                                               install_notary_change_acceptor)
+from corda_tpu.testing import DummyContract, DummyState, MockNetwork
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    notary_a = network.create_notary_node("O=Notary A, L=Zurich, C=CH")
+    notary_b = network.create_notary_node("O=Notary B, L=Geneva, C=CH")
+    alice = network.create_node("O=Alice, L=London, C=GB")
+    bob = network.create_node("O=Bob, L=Paris, C=FR")
+    network.start_nodes()
+    for node in (alice, bob):
+        install_notary_change_acceptor(node.smm)
+    return network, notary_a, notary_b, alice, bob
+
+
+def issue_shared_state(network, alice, bob, notary):
+    builder = TransactionBuilder(notary=notary.party)
+    builder.add_output_state(DummyState(
+        9, (alice.party.owning_key, bob.party.owning_key)))
+    builder.add_command(DummyContract.Create(), alice.party.owning_key)
+    wtx = builder.to_wire_transaction()
+    stx = alice.services.sign_initial_transaction(wtx)
+    fsm = alice.start_flow(FinalityFlow(stx))
+    network.run_network()
+    final = fsm.result_future.result(timeout=5)
+    return StateAndRef(final.tx.outputs[0], StateRef(final.id, 0))
+
+
+def test_notary_change_with_consent(net):
+    network, notary_a, notary_b, alice, bob = net
+    sref = issue_shared_state(network, alice, bob, notary_a)
+    assert sref.state.notary == notary_a.party
+
+    fsm = alice.start_flow(NotaryChangeFlow(sref, notary_b.party))
+    network.run_network()
+    new_ref = fsm.result_future.result(timeout=5)
+    assert new_ref.state.notary == notary_b.party
+    assert new_ref.state.data == sref.state.data
+    # bob co-signed and got the final transaction
+    final = alice.services.storage.get_transaction(new_ref.ref.txhash)
+    assert bob.party.owning_key in {s.by for s in final.sigs}
+    assert bob.services.storage.get_transaction(new_ref.ref.txhash) is not None
+    # old notary consumed the old state: respending under A now conflicts
+    from corda_tpu.flows.library import NotaryException, NotaryFlow
+    builder = TransactionBuilder()
+    builder.add_input_state(sref)
+    builder.add_output_state(DummyState(9, (alice.party.owning_key,)))
+    builder.add_command(DummyContract.Move(), alice.party.owning_key)
+    stale = alice.services.sign_initial_transaction(builder.to_wire_transaction())
+    fsm = alice.start_flow(NotaryFlow(stale))
+    network.run_network()
+    with pytest.raises(NotaryException):
+        fsm.result_future.result(timeout=5)
+
+
+def test_notary_change_to_same_notary_refused(net):
+    network, notary_a, notary_b, alice, bob = net
+    sref = issue_shared_state(network, alice, bob, notary_a)
+    fsm = alice.start_flow(NotaryChangeFlow(sref, notary_a.party))
+    network.run_network()
+    with pytest.raises(StateReplacementException, match="same"):
+        fsm.result_future.result(timeout=5)

@@ -1,0 +1,59 @@
+"""Sample-demo acceptance tests (the reference pattern: every sample has an
+integration test doubling as an end-to-end acceptance test — SURVEY.md §4.4).
+"""
+import pytest
+
+from corda_tpu.samples import attachment_demo, bank_of_corda, notary_demo
+
+
+def test_bank_of_corda_issuance():
+    from corda_tpu.finance import CashState
+    out = bank_of_corda.run_demo(amount_dollars=500)
+    holdings = out["requester"].services.vault.unconsumed_states(CashState)
+    assert sum(s.state.data.amount.quantity for s in holdings) == 500 * 100
+    # the issuer reference is the bank
+    assert all(str(s.state.data.amount.token.issuer.party.name)
+               == str(out["bank"].party.name) for s in holdings)
+
+
+def test_bank_of_corda_refuses_over_cap():
+    from corda_tpu.core.contracts.amount import Amount, USD
+    from corda_tpu.flows import FlowException
+    from corda_tpu.samples.bank_of_corda import IssuanceRequester, install_issuer
+    from corda_tpu.testing import MockNetwork
+    network = MockNetwork()
+    network.create_notary_node()
+    bank = network.create_node("O=BankOfCorda, L=London, C=GB")
+    requester = network.create_node("O=Greedy, L=Nowhere, C=US")
+    network.start_nodes()
+    install_issuer(bank.smm)
+    fsm = requester.start_flow(IssuanceRequester(
+        bank.party, Amount(10_000_000_00, USD)))
+    network.run_network()
+    with pytest.raises(FlowException, match="cap"):
+        fsm.result_future.result(timeout=5)
+
+
+def test_notary_demo_simple_and_validating():
+    out = notary_demo.run_demo(rounds=2)
+    assert out["notarised"] == 2
+    assert out["conflicts"] == 2
+    out = notary_demo.run_demo(rounds=1, validating=True)
+    assert out["notarised"] == 1
+    assert out["conflicts"] == 1
+
+
+def test_notary_demo_raft_cluster():
+    out = notary_demo.run_raft_demo(rounds=2)
+    assert out["notarised"] == 2
+    assert out["replicas_agree"]
+    assert out["commit_log_size"] == 2
+
+
+def test_attachment_demo():
+    out = attachment_demo.run_demo()
+    assert out["attachment"].data == out["document"]
+    assert out["attachment"].verify()
+    # the receiver resolved + recorded the attachment-bearing transaction
+    assert out["receiver"].services.storage.get_transaction(
+        out["final"].id) is not None
