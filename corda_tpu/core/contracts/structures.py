@@ -364,3 +364,15 @@ def requireThat(fn=None):
         yield _Requirements()
 
     return ctx()
+
+
+def tx_time_micros(tx) -> int | None:
+    """A transaction's attested instant: the time-window midpoint (or single
+    bound) in epoch micros — what time-sensitive contract rules (maturity,
+    default) check against. TimeWindow bounds are integer micros."""
+    tw = getattr(tx, "time_window", None)
+    if tw is None:
+        return None
+    if tw.from_time is not None and tw.until_time is not None:
+        return (tw.from_time + tw.until_time) // 2
+    return tw.from_time if tw.from_time is not None else tw.until_time

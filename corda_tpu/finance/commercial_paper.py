@@ -71,15 +71,7 @@ class CommercialPaperState(OwnableState):
 _NO_KEY = None  # sentinel inside without_owner comparisons
 
 
-def _tx_time_micros(tx) -> int | None:
-    """The time-window midpoint (or single bound) as epoch micros —
-    TimeWindow stores integer-micros bounds (structures.TimeWindow)."""
-    tw = tx.time_window
-    if tw is None:
-        return None
-    if tw.from_time is not None and tw.until_time is not None:
-        return (tw.from_time + tw.until_time) // 2
-    return tw.from_time if tw.from_time is not None else tw.until_time
+from ..core.contracts.structures import tx_time_micros as _tx_time_micros
 
 
 class IssueClause(Clause):

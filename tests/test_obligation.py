@@ -1,0 +1,196 @@
+"""Obligation contract tests (ObligationTests.kt analogs), via the ledger
+DSL and direct contract contexts: issue/move/settle/net/default rules."""
+import datetime
+
+import pytest
+
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.core.contracts.exceptions import TransactionVerificationException
+from corda_tpu.core.contracts.structures import (AuthenticatedObject, Issued,
+                                                 PartyAndReference, TimeWindow)
+from corda_tpu.core.crypto import generate_keypair
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.core.identity import Party
+from corda_tpu.core.serialization import deserialize, serialize
+from corda_tpu.core.serialization.codec import exact_epoch_micros
+from corda_tpu.core.transactions.ledger import TransactionForContract
+from corda_tpu.finance.cash import CashState
+from corda_tpu.finance.cash import Move as CashMove
+from corda_tpu.finance.obligation import (Lifecycle, Obligation,
+                                          ObligationState, Terms)
+
+BANK_KP = generate_keypair(entropy=b"\x81" * 32)
+BANK = Party("O=Issuer Bank, L=London, C=GB", BANK_KP.public)
+ALICE_KP = generate_keypair(entropy=b"\x82" * 32)
+BOB_KP = generate_keypair(entropy=b"\x83" * 32)
+
+NOW = datetime.datetime(2026, 7, 1, tzinfo=datetime.timezone.utc)
+DUE = exact_epoch_micros(NOW + datetime.timedelta(days=10))
+TOKEN = Issued(PartyAndReference(BANK, b"\x01"), USD)
+TERMS = Terms(TOKEN, DUE)
+OB = Obligation()
+
+
+def ctx(inputs, outputs, commands, at=NOW):
+    return TransactionForContract(
+        inputs=tuple(inputs), outputs=tuple(outputs), attachments=(),
+        commands=tuple(commands), id=SecureHash.sha256(b"ob-test"),
+        notary=None,
+        time_window=TimeWindow.with_tolerance(at, datetime.timedelta(seconds=5)))
+
+
+def cmd(data, *keys):
+    return AuthenticatedObject(tuple(keys), (), data)
+
+
+def owe(obligor_kp, beneficiary_kp, qty, lifecycle=Lifecycle.NORMAL):
+    return ObligationState(obligor_kp.public, TERMS, qty,
+                           beneficiary_kp.public, lifecycle)
+
+
+def test_issue_and_move():
+    OB.verify(ctx([], [owe(ALICE_KP, BOB_KP, 1000)],
+                  [cmd(Obligation.Issue(), ALICE_KP.public)]))
+    # only the obligor can bind themself
+    with pytest.raises(TransactionVerificationException, match="obligor"):
+        OB.verify(ctx([], [owe(ALICE_KP, BOB_KP, 1000)],
+                      [cmd(Obligation.Issue(), BOB_KP.public)]))
+    # move to a new beneficiary needs the current one
+    OB.verify(ctx([owe(ALICE_KP, BOB_KP, 1000)],
+                  [owe(ALICE_KP, BANK_KP, 1000)],
+                  [cmd(Obligation.Move(), BOB_KP.public)]))
+    with pytest.raises(TransactionVerificationException, match="beneficiary"):
+        OB.verify(ctx([owe(ALICE_KP, BOB_KP, 1000)],
+                      [owe(ALICE_KP, BANK_KP, 1000)],
+                      [cmd(Obligation.Move(), ALICE_KP.public)]))
+    # a move may not change the obligor
+    with pytest.raises(TransactionVerificationException, match="who owes"):
+        OB.verify(ctx([owe(ALICE_KP, BOB_KP, 1000)],
+                      [owe(BOB_KP, BOB_KP, 1000)],
+                      [cmd(Obligation.Move(), BOB_KP.public)]))
+
+
+def test_settlement_requires_payment():
+    payment = CashState(Amount(400, TOKEN), BOB_KP.public)
+    OB.verify(ctx([owe(ALICE_KP, BOB_KP, 1000)],
+                  [owe(ALICE_KP, BOB_KP, 600), payment],
+                  [cmd(Obligation.Settle(400), ALICE_KP.public),
+                   cmd(CashMove(), ALICE_KP.public)]))
+    # settling without the cash leg fails
+    with pytest.raises(TransactionVerificationException, match="pay"):
+        OB.verify(ctx([owe(ALICE_KP, BOB_KP, 1000)],
+                      [owe(ALICE_KP, BOB_KP, 600)],
+                      [cmd(Obligation.Settle(400), ALICE_KP.public)]))
+    # amounts must balance
+    with pytest.raises(TransactionVerificationException, match="balance"):
+        OB.verify(ctx([owe(ALICE_KP, BOB_KP, 1000)],
+                      [owe(ALICE_KP, BOB_KP, 700), payment],
+                      [cmd(Obligation.Settle(400), ALICE_KP.public),
+                       cmd(CashMove(), ALICE_KP.public)]))
+
+
+def test_bilateral_netting():
+    a_owes_b = owe(ALICE_KP, BOB_KP, 1000)
+    b_owes_a = owe(BOB_KP, ALICE_KP, 700)
+    netted = owe(ALICE_KP, BOB_KP, 300)
+    OB.verify(ctx([a_owes_b, b_owes_a], [netted],
+                  [cmd(Obligation.Net(), ALICE_KP.public, BOB_KP.public)]))
+    # value-destroying net is rejected
+    with pytest.raises(TransactionVerificationException, match="net position"):
+        OB.verify(ctx([a_owes_b, b_owes_a], [owe(ALICE_KP, BOB_KP, 200)],
+                      [cmd(Obligation.Net(), ALICE_KP.public, BOB_KP.public)]))
+    # everyone involved must sign
+    with pytest.raises(TransactionVerificationException, match="every party"):
+        OB.verify(ctx([a_owes_b, b_owes_a], [netted],
+                      [cmd(Obligation.Net(), ALICE_KP.public)]))
+
+
+CHARLIE_KP = generate_keypair(entropy=b"\x84" * 32)
+DAVE_KP = generate_keypair(entropy=b"\x85" * 32)
+
+
+def test_issue_cannot_destroy_other_claims():
+    """Attack: an Issue consuming someone else's claim while growing the
+    aggregate — per-claim accounting must reject it."""
+    with pytest.raises(TransactionVerificationException, match="reduce"):
+        OB.verify(ctx([owe(ALICE_KP, BOB_KP, 1000)],
+                      [owe(CHARLIE_KP, DAVE_KP, 1001)],
+                      [cmd(Obligation.Issue(), CHARLIE_KP.public)]))
+
+
+def test_net_cannot_fabricate_zero_sum_debt():
+    """Attack: netting nothing into two offsetting fabricated obligations —
+    the bound parties never signed."""
+    with pytest.raises(TransactionVerificationException, match="every party"):
+        OB.verify(ctx([], [owe(ALICE_KP, CHARLIE_KP, 500),
+                           owe(CHARLIE_KP, ALICE_KP, 500)],
+                      [cmd(Obligation.Net(), ALICE_KP.public)]))
+    # with both signatures it is allowed (a legitimate bilateral setup)
+    OB.verify(ctx([], [owe(ALICE_KP, CHARLIE_KP, 500),
+                       owe(CHARLIE_KP, ALICE_KP, 500)],
+                  [cmd(Obligation.Net(), ALICE_KP.public, CHARLIE_KP.public)]))
+
+
+def test_settle_cannot_redirect_remainder():
+    """Attack: settle 400 but replace the remaining 600 claim with an
+    unrelated pair — outputs creating new claims are rejected."""
+    payment = CashState(Amount(400, TOKEN), BOB_KP.public)
+    # rejected by the global cash-adequacy check (Bob's claim dropped 1000,
+    # only 400 paid) — and the per-claim clause would catch it after that
+    with pytest.raises(TransactionVerificationException,
+                       match="new claims|pay the beneficiary"):
+        OB.verify(ctx([owe(ALICE_KP, BOB_KP, 1000)],
+                      [owe(CHARLIE_KP, DAVE_KP, 600), payment],
+                      [cmd(Obligation.Settle(400), ALICE_KP.public),
+                       cmd(CashMove(), ALICE_KP.public)]))
+
+
+def test_move_cannot_flip_lifecycle():
+    """Attack: a Move that also flips to DEFAULTED before the due time."""
+    with pytest.raises(TransactionVerificationException, match="lifecycle"):
+        OB.verify(ctx([owe(ALICE_KP, BOB_KP, 1000)],
+                      [owe(ALICE_KP, BOB_KP, 1000, Lifecycle.DEFAULTED)],
+                      [cmd(Obligation.Move(), BOB_KP.public)]))
+
+
+def test_settle_cash_not_double_counted_across_groups():
+    """Attack: one 400 cash payment claimed against two obligation groups
+    (same product, different due dates) — the global adequacy check catches
+    the shortfall."""
+    terms2 = Terms(TOKEN, DUE + 1)
+    ob1 = owe(ALICE_KP, BOB_KP, 400)
+    ob2 = ObligationState(ALICE_KP.public, terms2, 400, BOB_KP.public)
+    payment = CashState(Amount(400, TOKEN), BOB_KP.public)
+    with pytest.raises(TransactionVerificationException, match="paid"):
+        OB.verify(ctx([ob1, ob2], [payment],
+                      [cmd(Obligation.Settle(400), ALICE_KP.public),
+                       cmd(Obligation.Settle(400), ALICE_KP.public),
+                       cmd(CashMove(), ALICE_KP.public)]))
+
+
+def test_multi_beneficiary_settlement_accepted():
+    """Two creditors fully paid in one transaction must verify (the old
+    per-input total check wrongly rejected this)."""
+    ob_bob = owe(ALICE_KP, BOB_KP, 400)
+    ob_carol = owe(ALICE_KP, CHARLIE_KP, 400)
+    pay_bob = CashState(Amount(400, TOKEN), BOB_KP.public)
+    pay_carol = CashState(Amount(400, TOKEN), CHARLIE_KP.public)
+    OB.verify(ctx([ob_bob, ob_carol], [pay_bob, pay_carol],
+                  [cmd(Obligation.Settle(800), ALICE_KP.public),
+                   cmd(CashMove(), ALICE_KP.public)]))
+
+
+def test_default_lifecycle():
+    after_due = NOW + datetime.timedelta(days=11)
+    normal = owe(ALICE_KP, BOB_KP, 1000)
+    defaulted = owe(ALICE_KP, BOB_KP, 1000, Lifecycle.DEFAULTED)
+    OB.verify(ctx([normal], [defaulted],
+                  [cmd(Obligation.SetLifecycle(Lifecycle.DEFAULTED),
+                       BOB_KP.public)], at=after_due))
+    # cannot default early
+    with pytest.raises(TransactionVerificationException, match="before"):
+        OB.verify(ctx([normal], [defaulted],
+                      [cmd(Obligation.SetLifecycle(Lifecycle.DEFAULTED),
+                           BOB_KP.public)], at=NOW))
+    # serialization roundtrip incl. the enum lifecycle
+    assert deserialize(serialize(defaulted)) == defaulted

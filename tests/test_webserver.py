@@ -1,0 +1,64 @@
+"""HTTP gateway tests (the webserver module's API surface)."""
+import json
+import urllib.request
+
+import pytest
+
+import corda_tpu.finance  # noqa: F401
+from corda_tpu.node.rpc import CordaRPCOps
+from corda_tpu.testing import MockNetwork
+from corda_tpu.tools.webserver import NodeWebServer
+
+
+@pytest.fixture
+def web():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    alice = network.create_node("O=Alice, L=Madrid, C=ES")
+    network.start_nodes()
+    ops = CordaRPCOps(alice.services, alice.smm)
+    server = NodeWebServer(ops, pump=network.run_network).start()
+    yield network, alice, server
+    server.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(server, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_rest_surface(web):
+    network, alice, server = web
+    status = _get(server, "/api/status")
+    assert status["identity"]["legal_identity"]["name"] == \
+        "O=Alice, L=Madrid, C=ES"
+    assert len(_get(server, "/api/network")) == 2
+    assert len(_get(server, "/api/notaries")) == 1
+    assert "CashIssueFlow" in str(_get(server, "/api/flows"))
+    assert _get(server, "/api/vault") == []
+
+    # start a cash issuance through REST
+    out = _post(server, "/api/flows/CashIssueFlow", [
+        {"amount": 12300, "currency": "USD"},
+        {"hex": "01"},
+        {"party": "O=Alice, L=Madrid, C=ES"},
+        {"party": "O=Notary Service, L=Zurich, C=CH"},
+    ])
+    assert out["done"] and "result" in out
+    vault = _get(server, "/api/vault")
+    assert vault and vault[0]["state"]["data"]["amount"]["quantity"] == 12300
+    assert len(_get(server, "/api/transactions")) == 1
+
+    # unknown endpoint → 404 error body
+    with pytest.raises(urllib.error.HTTPError):
+        _get(server, "/api/nope")
