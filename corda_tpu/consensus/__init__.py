@@ -2,3 +2,4 @@
 RaftUniquenessProvider.kt, BFT-SMaRt via BFTSMaRt.kt)."""
 from .raft import RaftNode, RaftState  # noqa: F401
 from .raft_uniqueness import RaftUniquenessProvider  # noqa: F401
+from .bft import (BFTClient, BFTReplica, BFTUniquenessProvider)  # noqa: F401

@@ -1,0 +1,366 @@
+"""BFT total-order broadcast for the notary commit log (PBFT-style).
+
+Reference parity: the role BFT-SMaRt plays (node/services/transactions/
+BFTSMaRt.kt:73-145 Client via ServiceProxy.invokeOrdered, :169+ Replica via
+DefaultRecoverable; BFTNonValidatingNotaryService.kt): a 3f+1 replica
+cluster totally orders commit requests and each replica applies them to the
+same deterministic state machine; the client accepts a result once f+1
+replicas agree.
+
+Protocol (PBFT normal case): client Request → primary PrePrepare(view, seq)
+→ replicas Prepare → (2f matching) → Commit → (2f+1 matching) → execute in
+sequence order → Reply; the client waits for f+1 matching replies.
+View change is timeout-driven and simplified (documented): on 2f+1
+ViewChange votes the new primary re-proposes every request not yet executed
+— safe here because the notary state machine is idempotent per transaction
+id (re-committing the same tx id is a no-op, DistributedImmutableMap).
+Byzantine PRIMARY equivocation is detected by the prepare quorum; arbitrary
+byzantine replica behaviour beyond crash+equivocation is out of scope this
+round.
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ..core.serialization import deserialize, register_type, serialize
+from ..network.messaging import TopicSession
+
+log = logging.getLogger(__name__)
+
+TOPIC_BFT = "platform.bft"
+
+VIEW_CHANGE_TICKS = 20
+
+
+@dataclass(frozen=True)
+class Request:
+    request_id: int
+    client: str
+    entry: Any
+
+
+@dataclass(frozen=True)
+class PrePrepare:
+    view: int
+    seq: int
+    digest: bytes
+    request: Request
+
+
+@dataclass(frozen=True)
+class Prepare:
+    view: int
+    seq: int
+    digest: bytes
+    replica: str
+
+
+@dataclass(frozen=True)
+class CommitMsg:
+    view: int
+    seq: int
+    digest: bytes
+    replica: str
+
+
+@dataclass(frozen=True)
+class Reply:
+    request_id: int
+    replica: str
+    result: Any = None
+    error: str | None = None
+
+
+@dataclass(frozen=True)
+class ViewChange:
+    new_view: int
+    replica: str
+
+
+@dataclass(frozen=True)
+class NewView:
+    view: int
+    requests: tuple       # Request... to re-propose
+
+
+for _cls in (Request, PrePrepare, Prepare, CommitMsg, Reply, ViewChange,
+             NewView):
+    register_type(f"bft.{_cls.__name__}", _cls)
+
+
+def _digest(request: Request) -> bytes:
+    return hashlib.sha256(serialize(request)).digest()
+
+
+class BFTReplica:
+    """One of the 3f+1 replicas (BFTSMaRt.Replica / CordaServiceReplica)."""
+
+    def __init__(self, replica_id: str, replicas: list[str], messaging,
+                 apply_fn: Callable[[Any], Any]):
+        self.replica_id = replica_id
+        self.replicas = list(replicas)
+        self.index = replicas.index(replica_id)
+        self.n = len(replicas)
+        self.f = (self.n - 1) // 3
+        self.messaging = messaging
+        self.apply_fn = apply_fn
+        self.view = 0
+        self.next_seq = 0              # primary's sequence counter
+        self.executed_through = -1
+        self._log: dict[int, PrePrepare] = {}
+        self._prepares: dict[tuple, set] = {}
+        self._commits: dict[tuple, set] = {}
+        self._committed: dict[int, PrePrepare] = {}
+        self._executed_requests: set = set()
+        self._pending: dict[int, Request] = {}   # awaiting execution (by rid)
+        self._vc_votes: dict[int, set] = {}
+        self._ticks_waiting = 0
+        self._lock = threading.RLock()
+        messaging.add_message_handler(TopicSession(TOPIC_BFT), self._on_message)
+
+    # -- helpers -------------------------------------------------------------
+    @property
+    def primary(self) -> str:
+        return self.replicas[self.view % self.n]
+
+    @property
+    def is_primary(self) -> bool:
+        return self.primary == self.replica_id
+
+    def _broadcast(self, msg) -> None:
+        for r in self.replicas:
+            if r == self.replica_id:
+                self._handle(msg)
+            else:
+                self.messaging.send(TopicSession(TOPIC_BFT), serialize(msg), r)
+
+    def _send(self, to: str, msg) -> None:
+        self.messaging.send(TopicSession(TOPIC_BFT), serialize(msg), to)
+
+    # -- liveness ------------------------------------------------------------
+    def tick(self) -> None:
+        with self._lock:
+            if self._pending and not self.is_primary:
+                self._ticks_waiting += 1
+                if self._ticks_waiting >= VIEW_CHANGE_TICKS:
+                    self._ticks_waiting = 0
+                    self._vote_view_change(self.view + 1)
+            else:
+                self._ticks_waiting = 0
+
+    def _vote_view_change(self, new_view: int) -> None:
+        log.info("%s votes for view %d", self.replica_id, new_view)
+        self._broadcast(ViewChange(new_view, self.replica_id))
+
+    # -- message handling ----------------------------------------------------
+    def _on_message(self, msg) -> None:
+        self._handle(deserialize(msg.data))
+
+    def _handle(self, m) -> None:
+        with self._lock:
+            if isinstance(m, Request):
+                self._on_request(m)
+            elif isinstance(m, PrePrepare):
+                self._on_preprepare(m)
+            elif isinstance(m, Prepare):
+                self._on_prepare(m)
+            elif isinstance(m, CommitMsg):
+                self._on_commit(m)
+            elif isinstance(m, ViewChange):
+                self._on_view_change(m)
+            elif isinstance(m, NewView):
+                self._on_new_view(m)
+
+    def _on_request(self, req: Request) -> None:
+        if req.request_id in self._executed_requests:
+            return
+        self._pending[req.request_id] = req
+        if self.is_primary:
+            seq = self.next_seq
+            self.next_seq += 1
+            pp = PrePrepare(self.view, seq, _digest(req), req)
+            self._broadcast(pp)
+
+    def _on_preprepare(self, pp: PrePrepare) -> None:
+        if pp.view != self.view:
+            return
+        if pp.digest != _digest(pp.request):
+            # a forged digest would let an equivocating primary reach quorum
+            # on one digest while shipping different requests — reject it
+            self._vote_view_change(self.view + 1)
+            return
+        existing = self._log.get(pp.seq)
+        if existing is not None and existing.view == pp.view \
+                and existing.digest != pp.digest:
+            # primary equivocation within one view: refuse, push a view change
+            self._vote_view_change(self.view + 1)
+            return
+        self._log[pp.seq] = pp
+        self._pending.setdefault(pp.request.request_id, pp.request)
+        self._broadcast(Prepare(pp.view, pp.seq, pp.digest, self.replica_id))
+
+    def _on_prepare(self, p: Prepare) -> None:
+        if p.view != self.view:
+            return
+        key = (p.view, p.seq, p.digest)
+        votes = self._prepares.setdefault(key, set())
+        votes.add(p.replica)
+        # prepared: matching preprepare + 2f prepares; commit once
+        if len(votes) >= 2 * self.f and p.seq in self._log \
+                and self._log[p.seq].digest == p.digest \
+                and self.replica_id not in self._commits.get(key, set()):
+            self._broadcast(CommitMsg(p.view, p.seq, p.digest, self.replica_id))
+
+    def _on_commit(self, c: CommitMsg) -> None:
+        if c.view != self.view:
+            return
+        key = (c.view, c.seq, c.digest)
+        votes = self._commits.setdefault(key, set())
+        votes.add(c.replica)
+        if len(votes) >= 2 * self.f + 1 and c.seq in self._log \
+                and self._log[c.seq].digest == c.digest:
+            self._committed[c.seq] = self._log[c.seq]
+            self._execute_ready()
+
+    def _execute_ready(self) -> None:
+        while self.executed_through + 1 in self._committed:
+            self.executed_through += 1
+            pp = self._committed[self.executed_through]
+            req = pp.request
+            if req.request_id in self._executed_requests:
+                continue
+            self._executed_requests.add(req.request_id)
+            self._pending.pop(req.request_id, None)
+            self._ticks_waiting = 0
+            try:
+                result, error = self.apply_fn(req.entry), None
+            except Exception as e:
+                result, error = None, str(e)
+            self._send(req.client, Reply(req.request_id, self.replica_id,
+                                         result, error))
+            self._gc(self.executed_through)
+
+    def _gc(self, through: int) -> None:
+        """Prune per-sequence protocol state at/below the executed watermark
+        (the minimal stable-checkpoint analog) so replica memory tracks the
+        state machine, not total historical throughput."""
+        self._log = {s: pp for s, pp in self._log.items() if s > through}
+        self._committed = {s: pp for s, pp in self._committed.items()
+                           if s > through}
+        self._prepares = {k: v for k, v in self._prepares.items()
+                          if k[1] > through}
+        self._commits = {k: v for k, v in self._commits.items()
+                         if k[1] > through}
+
+    # -- view change (simplified; see module docstring) ----------------------
+    def _on_view_change(self, vc: ViewChange) -> None:
+        if vc.new_view <= self.view:
+            return
+        votes = self._vc_votes.setdefault(vc.new_view, set())
+        votes.add(vc.replica)
+        # PBFT join rule: co-vote once f+1 others want the change, regardless
+        # of local pending state — otherwise a replica that never saw the
+        # client request blocks the 2f+1 quorum at exactly 2f+1 live replicas
+        if self.replica_id not in votes and len(votes) >= self.f + 1:
+            votes.add(self.replica_id)
+            self._broadcast(ViewChange(vc.new_view, self.replica_id))
+        if len(votes) >= 2 * self.f + 1:
+            self._enter_view(vc.new_view)
+
+    def _enter_view(self, view: int) -> None:
+        self.view = view
+        self._ticks_waiting = 0
+        # un-executed slots from dead views must not collide with the new
+        # primary's fresh sequence assignment
+        self._log = {s: pp for s, pp in self._log.items()
+                     if s <= self.executed_through}
+        if self.is_primary:
+            # re-propose everything not yet executed (idempotent state machine)
+            reqs = tuple(self._pending.values())
+            log.info("%s is primary of view %d, re-proposing %d requests",
+                     self.replica_id, view, len(reqs))
+            self.next_seq = self.executed_through + 1
+            self._broadcast(NewView(view, reqs))
+            for req in reqs:
+                self._on_request(req)
+
+    def _on_new_view(self, nv: NewView) -> None:
+        if nv.view < self.view:
+            return
+        self.view = nv.view
+        self._ticks_waiting = 0
+        for req in nv.requests:
+            if req.request_id not in self._executed_requests:
+                self._pending.setdefault(req.request_id, req)
+
+
+class BFTClient:
+    """The ServiceProxy.invokeOrdered analog: broadcast the request to every
+    replica, accept once f+1 replicas return the same verdict."""
+
+    def __init__(self, client_id: str, replicas: list[str], messaging):
+        self.client_id = client_id
+        self.replicas = list(replicas)
+        self.f = (len(replicas) - 1) // 3
+        self.messaging = messaging
+        self._ids = iter(range(1, 1 << 62))
+        self._waiting: dict[int, dict] = {}
+        self._lock = threading.Lock()
+        messaging.add_message_handler(TopicSession(TOPIC_BFT), self._on_reply)
+
+    def submit(self, entry) -> Future:
+        with self._lock:
+            rid = next(self._ids)
+            fut: Future = Future()
+            fut.bft_request_id = rid
+            self._waiting[rid] = {"future": fut, "replies": {}}
+        req = Request(rid, self.client_id, entry)
+        for r in self.replicas:
+            self.messaging.send(TopicSession(TOPIC_BFT), serialize(req), r)
+        return fut
+
+    def abandon(self, fut: Future) -> None:
+        with self._lock:
+            self._waiting.pop(getattr(fut, "bft_request_id", None), None)
+
+    def _on_reply(self, msg) -> None:
+        m = deserialize(msg.data)
+        if not isinstance(m, Reply):
+            return
+        with self._lock:
+            entry = self._waiting.get(m.request_id)
+            if entry is None:
+                return
+            key = serialize([m.result, m.error])
+            entry["replies"].setdefault(key, set()).add(m.replica)
+            if len(entry["replies"][key]) >= self.f + 1:
+                del self._waiting[m.request_id]
+                fut = entry["future"]
+            else:
+                return
+        if m.error is not None:
+            fut.set_exception(BFTApplyError(m.error))
+        else:
+            fut.set_result(m.result)
+
+
+class BFTApplyError(Exception):
+    pass
+
+
+class BFTUniquenessProvider:
+    """UniquenessProvider over the BFT cluster (BFTSMaRt.Client.
+    commitTransaction semantics)."""
+
+    def __init__(self, client: BFTClient, timeout_s: float = 30.0):
+        self.client = client
+        self.timeout_s = timeout_s
+
+    def commit(self, states, tx_id, caller: str) -> None:
+        from .provider import consensus_commit
+        consensus_commit(self.client, states, tx_id, caller, self.timeout_s)

@@ -1,0 +1,27 @@
+"""Shared commit path for consensus-backed uniqueness providers.
+
+Both the Raft and BFT notary backends expose submit()/abandon() and apply
+the same DistributedImmutableMap commands; this is the one place the
+blocking commit semantics (timeout, pending-table hygiene, conflict
+surfacing) live.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+
+from ..node.notary import UniquenessException
+
+
+def consensus_commit(backend, states, tx_id, caller: str,
+                     timeout_s: float) -> None:
+    """Submit a put_all to `backend` (RaftNode or BFTClient) and block until
+    the replicated state machine answers; abandon the pending entry on
+    timeout so the request table cannot leak."""
+    fut = backend.submit(("put_all", [tx_id, list(states), caller]))
+    try:
+        result = fut.result(timeout=timeout_s)
+    except concurrent.futures.TimeoutError:
+        backend.abandon(fut)
+        raise
+    if not result["committed"]:
+        raise UniquenessException(result["conflicts"])

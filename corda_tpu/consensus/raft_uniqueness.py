@@ -8,7 +8,7 @@ applied identically on every replica.
 """
 from __future__ import annotations
 
-from ..node.notary import ConsumedStateDetails, UniquenessException, UniquenessProvider
+from ..node.notary import ConsumedStateDetails, UniquenessProvider
 from .raft import RaftNode
 
 
@@ -54,12 +54,5 @@ class RaftUniquenessProvider(UniquenessProvider):
         return provider
 
     def commit(self, states, tx_id, caller: str) -> None:
-        import concurrent.futures
-        fut = self.raft.submit(("put_all", [tx_id, list(states), caller]))
-        try:
-            result = fut.result(timeout=self.timeout_s)
-        except concurrent.futures.TimeoutError:
-            self.raft.abandon(fut)  # don't leak the pending-request entry
-            raise
-        if not result["committed"]:
-            raise UniquenessException(result["conflicts"])
+        from .provider import consensus_commit
+        consensus_commit(self.raft, states, tx_id, caller, self.timeout_s)

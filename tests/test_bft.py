@@ -1,0 +1,103 @@
+"""BFT notary cluster tests (BFTNotaryServiceTests analogs): total-order
+commitment over 4 replicas, crash tolerance of f=1, primary-failure view
+change, replicated double-spend conflicts."""
+import pytest
+
+from corda_tpu.consensus.bft import (BFTClient, BFTReplica,
+                                     BFTUniquenessProvider)
+from corda_tpu.consensus.raft_uniqueness import DistributedImmutableMap
+from corda_tpu.core.contracts.structures import StateRef
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.network.inmemory import InMemoryMessagingNetwork
+from corda_tpu.node.notary import UniquenessException
+
+
+def make_cluster(n=4):
+    bus = InMemoryMessagingNetwork()
+    names = [f"bft{i}" for i in range(n)]
+    machines = [DistributedImmutableMap() for _ in range(n)]
+    replicas = [BFTReplica(name, names, bus.create_node(name),
+                           machines[i].apply)
+                for i, name in enumerate(names)]
+    client = BFTClient("client", names, bus.create_node("client"))
+    return bus, replicas, machines, client
+
+
+def ref(i):
+    return StateRef(SecureHash.sha256(bytes([i])), 0)
+
+
+def commit_entry(tx_label, refs):
+    return ("put_all", [SecureHash.sha256(tx_label), list(refs), "caller"])
+
+
+def pump(bus, replicas, ticks=1):
+    for _ in range(ticks):
+        for r in replicas:
+            r.tick()
+        bus.run_network()
+
+
+def test_total_order_commitment():
+    bus, replicas, machines, client = make_cluster()
+    fut = client.submit(commit_entry(b"t1", [ref(1)]))
+    pump(bus, replicas)
+    assert fut.result(timeout=1)["committed"]
+    fut2 = client.submit(commit_entry(b"t2", [ref(1)]))  # double spend
+    pump(bus, replicas)
+    assert not fut2.result(timeout=1)["committed"]
+    # every replica applied both, in the same order, with identical state
+    assert all(len(m) == 1 for m in machines)
+    assert all(r.executed_through == 1 for r in replicas)
+
+
+def test_tolerates_one_crashed_replica():
+    bus, replicas, machines, client = make_cluster()
+    # silence a NON-primary replica (f = 1)
+    dead = replicas[3]
+    bus.transfer_filter = lambda t: t.recipient != dead.replica_id
+    fut = client.submit(commit_entry(b"t1", [ref(1)]))
+    pump(bus, replicas[:3])
+    assert fut.result(timeout=1)["committed"]
+    assert all(len(machines[i]) == 1 for i in range(3))
+
+
+def test_view_change_on_primary_failure():
+    bus, replicas, machines, client = make_cluster()
+    primary = replicas[0]
+    assert primary.is_primary
+    bus.transfer_filter = lambda t: primary.replica_id not in (t.sender,
+                                                               t.recipient)
+    live = replicas[1:]
+    fut = client.submit(commit_entry(b"t1", [ref(1)]))
+    pump(bus, live, ticks=60)   # past the view-change timeout
+    assert fut.result(timeout=1)["committed"]
+    assert all(r.view >= 1 for r in live)
+    assert all(len(machines[i]) == 1 for i in range(1, 4))
+
+
+def test_bft_uniqueness_provider():
+    import threading
+    bus, replicas, machines, client = make_cluster()
+    provider = BFTUniquenessProvider(client)
+    results = {}
+
+    def commit(key, label):
+        try:
+            provider.commit([ref(9)], SecureHash.sha256(label), "me")
+            results[key] = "ok"
+        except UniquenessException as e:
+            results[key] = e.conflicts
+
+    for key, label in (("first", b"a"), ("second", b"b")):
+        t = threading.Thread(target=commit, args=(key, label))
+        t.start()
+        for _ in range(50):
+            pump(bus, replicas)
+            if key in results:
+                break
+            import time
+            time.sleep(0.01)
+        t.join(timeout=5)
+    assert results["first"] == "ok"
+    assert ref(9) in results["second"]
