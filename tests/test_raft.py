@@ -116,6 +116,7 @@ def test_raft_uniqueness_provider_conflicts():
     tx2 = SecureHash.sha256(b"spend-2")
 
     import threading
+    import time
     results = {}
 
     def commit(key, tx_id):
@@ -125,16 +126,22 @@ def test_raft_uniqueness_provider_conflicts():
         except UniquenessException as e:
             results[key] = e.conflicts
 
-    t1 = threading.Thread(target=commit, args=("first", tx1))
-    t1.start()
-    pump(bus, nodes, 20)
-    t1.join(timeout=5)
+    def run_and_pump(key, tx_id):
+        """Pump until the commit thread reports — a fixed pump count races
+        thread scheduling on a loaded box."""
+        t = threading.Thread(target=commit, args=(key, tx_id))
+        t.start()
+        deadline = time.monotonic() + 20
+        while key not in results and time.monotonic() < deadline:
+            pump(bus, nodes, 5)
+            time.sleep(0.01)
+        t.join(timeout=5)
+        assert key in results, f"consensus for {key} did not complete"
+
+    run_and_pump("first", tx1)
     assert results["first"] == "ok"
 
-    t2 = threading.Thread(target=commit, args=("second", tx2))
-    t2.start()
-    pump(bus, nodes, 20)
-    t2.join(timeout=5)
+    run_and_pump("second", tx2)
     conflicts = results["second"]
     assert conflicts != "ok" and ref in conflicts
     assert conflicts[ref].consuming_tx == tx1
