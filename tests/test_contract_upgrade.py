@@ -1,0 +1,111 @@
+"""Contract upgrade flow tests (ContractUpgradeFlowTest analogs): authorised
+upgrades succeed with all signatures; unauthorised or tampered ones refuse."""
+import pytest
+
+from corda_tpu.core.contracts import Command, TransactionState
+from corda_tpu.core.contracts.structures import StateAndRef, StateRef
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.core.serialization import register_type, serializable
+from corda_tpu.core.transactions import TransactionBuilder
+from corda_tpu.flows.contract_upgrade import (ContractUpgradeException,
+                                              ContractUpgradeFlow,
+                                              UpgradeCommand, UpgradedContract,
+                                              authorise_contract_upgrade,
+                                              install_contract_upgrade_acceptor)
+from corda_tpu.flows.library import FinalityFlow
+from corda_tpu.testing import DummyContract, DummyState, MockNetwork
+from corda_tpu.testing.dummy import _DUMMY_CONTRACT
+
+
+@serializable("test.DummyStateV2",
+              to_fields=lambda s: [s.magic_number, list(s.owners)],
+              from_fields=lambda f: DummyStateV2(f[0], tuple(f[1])))
+class DummyStateV2:
+    def __init__(self, magic_number, owners):
+        self.magic_number = magic_number
+        self.owners = tuple(owners)
+
+    @property
+    def contract(self):
+        return DUMMY_V2
+
+    @property
+    def participants(self):
+        return list(self.owners)
+
+    def __eq__(self, other):
+        return (isinstance(other, DummyStateV2)
+                and other.magic_number == self.magic_number
+                and other.owners == self.owners)
+
+    def __hash__(self):
+        return hash((self.magic_number, self.owners))
+
+
+class DummyContractV2(UpgradedContract):
+    legacy_contract_name = (f"{DummyContract.__module__}."
+                            f"{DummyContract.__qualname__}")
+    legal_contract_reference = SecureHash.sha256(b"dummy v2")
+
+    def upgrade(self, old_state):
+        return DummyStateV2(old_state.magic_number * 100, old_state.owners)
+
+    def verify(self, tx) -> None:
+        pass  # accepts upgrades
+
+
+DUMMY_V2 = DummyContractV2()
+register_type("test.DummyContractV2", DummyContractV2,
+              to_fields=lambda c: [], from_fields=lambda f: DUMMY_V2)
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    alice = network.create_node("O=Alice, L=London, C=GB")
+    bob = network.create_node("O=Bob, L=Paris, C=FR")
+    network.start_nodes()
+    for node in (alice, bob):
+        install_contract_upgrade_acceptor(node.smm)
+    return network, notary, alice, bob
+
+
+def issue_shared(network, alice, bob, notary):
+    builder = TransactionBuilder(notary=notary.party)
+    builder.add_output_state(DummyState(
+        5, (alice.party.owning_key, bob.party.owning_key)))
+    builder.add_command(DummyContract.Create(), alice.party.owning_key)
+    stx = alice.services.sign_initial_transaction(builder.to_wire_transaction())
+    fsm = alice.start_flow(FinalityFlow(stx))
+    network.run_network()
+    final = fsm.result_future.result(timeout=5)
+    return StateAndRef(final.tx.outputs[0], StateRef(final.id, 0))
+
+
+def test_authorised_upgrade_succeeds(net):
+    network, notary, alice, bob = net
+    sref = issue_shared(network, alice, bob, notary)
+    # bob authorises; alice instigates
+    authorise_contract_upgrade(bob.services, sref, DummyContractV2)
+    fsm = alice.start_flow(ContractUpgradeFlow(sref, DUMMY_V2))
+    network.run_network()
+    new_ref = fsm.result_future.result(timeout=5)
+    assert isinstance(new_ref.state.data, DummyStateV2)
+    assert new_ref.state.data.magic_number == 500
+    final = alice.services.storage.get_transaction(new_ref.ref.txhash)
+    assert bob.party.owning_key in {s.by for s in final.sigs}
+    assert isinstance(final.tx.commands[0].value, UpgradeCommand)
+    # bob's vault follows the upgrade
+    assert bob.services.storage.get_transaction(new_ref.ref.txhash) is not None
+
+
+def test_unauthorised_upgrade_refused(net):
+    network, notary, alice, bob = net
+    sref = issue_shared(network, alice, bob, notary)
+    fsm = alice.start_flow(ContractUpgradeFlow(sref, DUMMY_V2))
+    network.run_network()
+    from corda_tpu.flows import FlowException
+    # the acceptor's refusal crosses the session as a FlowException message
+    with pytest.raises(FlowException, match="not authorised"):
+        fsm.result_future.result(timeout=5)
