@@ -150,6 +150,28 @@ class FlowLogic:
         data = yield SendAndReceive(party, payload, expected_type)
         return data
 
+    def send_and_receive_with_retry(self, party: Party, payload,
+                                    expected_type: type = object,
+                                    attempts: int = 3) -> Generator:
+        """Retry the exchange on session failure — for IDEMPOTENT requests to
+        clustered services whose members may fail over mid-request
+        (FlowLogic.kt:106-113 sendAndReceiveWithRetry)."""
+        last: Exception | None = None
+        for _ in range(attempts):
+            try:
+                data = yield SendAndReceive(party, payload, expected_type)
+                return data
+            except FlowException as e:
+                last = e
+                # the failed session is dead; drop it (routing index included)
+                # so the retry opens a FRESH one and a straggler reply on the
+                # old session id can't be mistaken for the new attempt's
+                fsm = self.state_machine
+                if fsm is not None:
+                    fsm.smm.discard_session(fsm, fsm.current_group[0],
+                                            str(party.name))
+        raise last if last is not None else FlowException("retry exhausted")
+
     def wait_for_ledger_commit(self, tx_id) -> Generator:
         stx = yield WaitForLedgerCommit(tx_id)
         return stx

@@ -207,6 +207,20 @@ class StateMachineManager:
                     action = self._reexecute_parked(fsm, request)
                 else:
                     action = self._execute_request(fsm, request)
+            except FlowException as e:
+                # session-state errors surface AT THE CALL SITE so flow code
+                # (e.g. sendAndReceiveWithRetry) can catch and recover —
+                # reference FlowLogic semantics
+                fsm.response_log.append(("error", str(e)))
+                try:
+                    request = gen.throw(e)
+                    continue
+                except StopIteration as stop:
+                    self._complete(fsm, stop.value)
+                    return
+                except Exception as e2:
+                    self._fail(fsm, e2)
+                    return
             except Exception as e:
                 self._fail(fsm, e)
                 return
@@ -406,6 +420,15 @@ class StateMachineManager:
 
     def register_flow_factory(self, initiator_name: str, factory) -> None:
         self.flow_factories[initiator_name] = factory
+
+    def discard_session(self, fsm: FlowStateMachine, group: int,
+                        party_name: str) -> None:
+        """Forget a (dead) session entirely — including its inbound-routing
+        index entry, so a late message on the old session id can never reach
+        the flow again (the retry helper's fresh-session semantics)."""
+        sess = fsm.sessions.pop((group, party_name), None)
+        if sess is not None:
+            self._session_index.pop(sess.our_session_id, None)
 
     def _on_session_init(self, init: SessionInit) -> None:
         factory = (self.flow_factories.get(init.flow_name)

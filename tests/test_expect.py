@@ -1,0 +1,56 @@
+"""Expect DSL tests (Expect.kt analog) over real vault/state-machine events."""
+import pytest
+
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+from corda_tpu.node.vault import VaultUpdate
+from corda_tpu.testing import MockNetwork
+from corda_tpu.testing.expect import (ExpectationFailed, expect, parallel,
+                                      repeat, run_expectations, sequence)
+
+
+def test_sequence_and_parallel_matching():
+    events = ["start", 1, 2, "mid", 3, "end"]
+    run_expectations(events, sequence(
+        expect(str, lambda s: s == "start"),
+        parallel(expect(int, lambda i: i == 2), expect(int, lambda i: i == 1)),
+        expect(str, lambda s: s == "end")), strict=False)
+    with pytest.raises(ExpectationFailed):
+        run_expectations(events, sequence(
+            expect(str, lambda s: s == "end"),
+            expect(str, lambda s: s == "start")), strict=False)  # wrong order
+    run_expectations([7, 7, 7], repeat(3, expect(int, lambda i: i == 7)))
+    with pytest.raises(ExpectationFailed):
+        run_expectations([7, 7], repeat(3, expect(int, lambda i: i == 7)))
+    # strict mode flags unexpected events; backtracking finds the valid
+    # assignment when an unconstrained leaf could shadow a constrained one
+    with pytest.raises(ExpectationFailed, match="unexpected|satisfies"):
+        run_expectations(["extra", 7], sequence(expect(int)))
+    run_expectations([1, 2], parallel(expect(int),
+                                      expect(int, lambda i: i == 1)))
+    # vacuous expectations pass on empty streams
+    run_expectations([], repeat(0, expect(int)))
+    run_expectations([], sequence())
+
+
+def test_expect_over_vault_updates():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    bank = network.create_node("O=Bank, L=London, C=GB")
+    alice = network.create_node("O=Alice, L=Madrid, C=ES")
+    network.start_nodes()
+
+    events = []
+    bank.services.vault.add_update_observer(events.append)
+    fsm = bank.start_flow(CashIssueFlow(Amount(10000, USD), b"\x01",
+                                        bank.party, notary.party))
+    network.run_network()
+    fsm.result_future.result(timeout=5)
+    fsm = bank.start_flow(CashPaymentFlow(Amount(4000, USD), alice.party))
+    network.run_network()
+    fsm.result_future.result(timeout=5)
+
+    run_expectations(events, sequence(
+        expect(VaultUpdate, lambda u: len(u.produced) == 1 and not u.consumed),
+        expect(VaultUpdate,
+               lambda u: len(u.consumed) == 1 and len(u.produced) == 1)))

@@ -187,6 +187,44 @@ def test_receive_on_dead_session_fails_fast(net):
         fsm.result_future.result(timeout=1)
 
 
+@initiating_flow
+class RetryingFlow(FlowLogic):
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        answer = yield from self.send_and_receive_with_retry(self.peer, "ping",
+                                                             str, attempts=3)
+        return answer.unwrap(lambda d: d)
+
+
+# grumpy twice, then answers — only a per-attempt FRESH session can succeed
+_GRUMPY_COUNT = {"n": 0}
+
+
+@initiated_by(RetryingFlow)
+class EventuallyHelpful(FlowLogic):
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        msg = yield Receive(self.peer, str)
+        _GRUMPY_COUNT["n"] += 1
+        if _GRUMPY_COUNT["n"] < 3:
+            raise FlowException("not yet")
+        yield Send(self.peer, "pong")
+        return None
+
+
+def test_send_and_receive_with_retry(net):
+    network, a, b = net
+    _GRUMPY_COUNT["n"] = 0
+    fsm = a.start_flow(RetryingFlow(b.party))
+    network.run_network()
+    assert fsm.result_future.result(timeout=1) == "pong"
+    assert _GRUMPY_COUNT["n"] == 3
+
+
 def test_flow_completion_removes_checkpoints(net):
     network, a, b = net
     a.start_flow(PingFlow(b.party))
