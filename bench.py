@@ -65,16 +65,16 @@ def host_baseline_rate(items) -> float:
 
 def device_rate(items) -> float:
     kitems = [(pub, msg, r, s) for _, pub, msg, r, s in items]
-    u1, u2, q, rc, pre = wc_ops.prepare_batch(ecmath.SECP256K1, kitems)
+    *args, pre = wc_ops.prepare_batch_hybrid(kitems)
     assert pre.all()
-    fn = wc_ops._verify_kernel
-    ok = np.asarray(fn(u1, u2, q, rc, "secp256k1"))  # compile + warm
+    fn = wc_ops._verify_kernel_hybrid
+    ok = np.asarray(fn(*args))  # compile + warm
     assert bool(ok.all()), "benchmark signatures must all verify"
     t0 = time.perf_counter()
     for _ in range(REPS):
         # the host copy is a hard sync: async dispatch through the device
         # tunnel makes block_until_ready alone under-measure
-        ok = np.asarray(fn(u1, u2, q, rc, "secp256k1"))
+        ok = np.asarray(fn(*args))
     dt = time.perf_counter() - t0
     return len(items) * REPS / dt
 

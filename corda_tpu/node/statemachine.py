@@ -425,7 +425,15 @@ class StateMachineManager:
                         party_name: str) -> None:
         """Forget a (dead) session entirely — including its inbound-routing
         index entry, so a late message on the old session id can never reach
-        the flow again (the retry helper's fresh-session semantics)."""
+        the flow again (the retry helper's fresh-session semantics).
+
+        No-op during checkpoint replay: the logged error that triggered the
+        original discard is being replayed from the response log, but the
+        session in the table is the *restored* (live) one — popping it would
+        orphan the flow's later exchanges with the same party (same principle
+        as ExecuteOnce: side effects must not re-run during replay)."""
+        if fsm.replaying:
+            return
         sess = fsm.sessions.pop((group, party_name), None)
         if sess is not None:
             self._session_index.pop(sess.our_session_id, None)

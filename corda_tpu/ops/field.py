@@ -270,9 +270,14 @@ def neg(a, p: int):
     return sub(jnp.zeros_like(a), a, p)
 
 
+# Bound on mul_const's scalar: limb bound (< 2^18) x constant must stay under
+# the u64 column capacity with headroom for the normalize walk.
+MUL_CONST_MAX = 1 << 45
+
+
 def mul_const(a, c: int, p: int):
-    """Multiply by a small host constant (c < 2^45)."""
-    assert 0 <= c < (1 << 45)
+    """Multiply by a small host constant (c < MUL_CONST_MAX)."""
+    assert 0 <= c < MUL_CONST_MAX
     if c == 0:
         return jnp.zeros_like(a)
     nb = [b * c for b in _CONTRACT]

@@ -16,9 +16,9 @@ BouncyCastle). TPU-first design notes:
 
 ECDSA verify (SEC 1 v2 §4.1.4): with e = H(m) as int, w = s⁻¹ mod n,
 u1 = e·w, u2 = r·w (host, cheap), accept iff X = [u1]G + [u2]Q ≠ ∞ and
-x(X) ≡ r (mod n). The final affine conversion is a device Fermat inversion;
-x ≡ r (mod n) is checked as x == r or x == r + n (only candidates with
-x < p, r < n < p), with the r+n candidate host-validated.
+x(X) ≡ r (mod n). x ≡ r (mod n) is checked as x ∈ {r, r + n} (the only
+candidates with x < p, r < n < p), with the r+n candidate host-validated;
+the affine check X/Z == r_cand is done projectively as X == r_cand·Z.
 """
 from __future__ import annotations
 
@@ -45,11 +45,45 @@ def identity(shape) -> tuple:
     return (z, z.at[..., 0].set(1), z)
 
 
+def _select4(idx, points):
+    """4-way batched point select: idx (B,) in [0,4) over 4 projective
+    triples → one triple (binary tree of two-way selects per coordinate)."""
+    return tuple(
+        F.select(idx == 3, c3,
+                 F.select(idx == 2, c2, F.select(idx == 1, c1, c0)))
+        for c0, c1, c2, c3 in zip(*points))
+
+
+def _points_to_limbs(col):
+    """Affine host points [(x, y)] → projective limb triple with Z = 1."""
+    px = jnp.asarray(F.to_limbs([pt[0] for pt in col]))
+    py = jnp.asarray(F.to_limbs([pt[1] for pt in col]))
+    pz = jnp.zeros_like(px).at[..., 0].set(1)
+    return (px, py, pz)
+
+
 def add(Pt, Qt, curve: WeierstrassCurve):
-    """RCB16 Algorithm 1: complete projective addition, arbitrary a."""
+    """RCB16 complete projective addition, specialized at trace time.
+
+    Three variants chosen by the curve constants (all complete):
+    - ``a == 0`` (secp256k1): the three a·x products are identically zero and
+      drop out (RCB16 Algorithm 7 shape); with b3 = 21 small, both b3·x
+      products are ``mul_const`` — 12 full field muls per point-add instead
+      of 17.
+    - ``a ≡ -small`` (secp256r1, a = -3): a·x = -(|a|·x) via ``mul_const`` +
+      subtraction — 12 full muls + cheap constant muls.
+    - general a: Algorithm 1 verbatim.
+    """
     p = curve.p
-    a_c = _const(curve.a, p)
-    b3_c = _const(3 * curve.b, p)
+    a = curve.a % p
+    b3 = 3 * curve.b % p
+    neg_a = p - a           # |a| when a is a small negative constant
+    small = F.MUL_CONST_MAX
+    b3_c = None if b3 < small else _const(b3, p)
+
+    def mul_b3(x):
+        return F.mul_const(x, b3, p) if b3_c is None else F.mul(x, b3_c, p)
+
     X1, Y1, Z1 = Pt
     X2, Y2, Z2 = Qt
     t0 = F.mul(X1, X2, p)
@@ -70,20 +104,40 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     t5 = F.mul(t5, X3, p)
     X3 = F.add(t1, t2, p)
     t5 = F.sub(t5, X3, p)
-    Z3 = F.mul(a_c, t4, p)
-    X3 = F.mul(b3_c, t2, p)
-    Z3 = F.add(X3, Z3, p)
-    X3 = F.sub(t1, Z3, p)
-    Z3 = F.add(t1, Z3, p)
-    Y3 = F.mul(X3, Z3, p)
-    t1 = F.add(t0, t0, p)
-    t1 = F.add(t1, t0, p)
-    t2 = F.mul(a_c, t2, p)
-    t4 = F.mul(b3_c, t4, p)
-    t1 = F.add(t1, t2, p)
-    t2 = F.sub(t0, t2, p)
-    t2 = F.mul(a_c, t2, p)
-    t4 = F.add(t4, t2, p)
+    if a == 0:
+        # Z3 = b3·t2 + a·t4 = b3·t2 ;  t1' = 3t0 + a·t2 = 3t0 ;
+        # t4' = b3·t4 + a·(t0 - a·t2) = b3·t4
+        Z3 = mul_b3(t2)
+        X3 = F.sub(t1, Z3, p)
+        Z3 = F.add(t1, Z3, p)
+        Y3 = F.mul(X3, Z3, p)
+        t1 = F.mul_const(t0, 3, p)
+        t4 = mul_b3(t4)
+    elif neg_a < small:
+        # a = -|a|:  Z3 = b3·t2 - |a|·t4 ;  t1' = 3t0 - |a|·t2 ;
+        # t4' = b3·t4 + a·(t0 - a·t2) = b3·t4 - |a|·(t0 + |a|·t2)
+        Z3 = F.sub(mul_b3(t2), F.mul_const(t4, neg_a, p), p)
+        X3 = F.sub(t1, Z3, p)
+        Z3 = F.add(t1, Z3, p)
+        Y3 = F.mul(X3, Z3, p)
+        m = F.add(t0, F.mul_const(t2, neg_a, p), p)   # t0 - a·t2
+        t1 = F.sub(F.mul_const(t0, 3, p), F.mul_const(t2, neg_a, p), p)
+        t4 = F.sub(mul_b3(t4), F.mul_const(m, neg_a, p), p)
+    else:
+        a_c = _const(a, p)
+        Z3 = F.mul(a_c, t4, p)
+        X3 = mul_b3(t2)
+        Z3 = F.add(X3, Z3, p)
+        X3 = F.sub(t1, Z3, p)
+        Z3 = F.add(t1, Z3, p)
+        Y3 = F.mul(X3, Z3, p)
+        t1 = F.mul_const(t0, 3, p)
+        t2 = F.mul(a_c, t2, p)
+        t4 = mul_b3(t4)
+        t1 = F.add(t1, t2, p)
+        t2 = F.sub(t0, t2, p)
+        t2 = F.mul(a_c, t2, p)
+        t4 = F.add(t4, t2, p)
     t0 = F.mul(t1, t4, p)
     Y3 = F.add(Y3, t0, p)
     t0 = F.mul(t5, t4, p)
@@ -95,20 +149,44 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     return (X3, Y3, Z3)
 
 
+def dbl(Pt, curve: WeierstrassCurve):
+    """Complete projective doubling. For a = 0 with small b3 (secp256k1):
+    RCB16 Algorithm 9 — 8 full field muls + 4 constant muls versus the 12+2
+    of the complete add (doubling chains like 8Y² collapse into single
+    ``mul_const`` normalizations). Complete for every input including the
+    identity (0:1:0). Other curves fall back to add(P, P), which is complete
+    and already specialized per curve constants."""
+    p = curve.p
+    a = curve.a % p
+    b3 = 3 * curve.b % p
+    if a != 0 or b3 >= F.MUL_CONST_MAX:
+        return add(Pt, Pt, curve)
+    X, Y, Z = Pt
+    t0 = F.mul(Y, Y, p)
+    Z3 = F.mul_const(t0, 8, p)
+    t1 = F.mul(Y, Z, p)
+    t2 = F.mul_const(F.mul(Z, Z, p), b3, p)
+    X3 = F.mul(t2, Z3, p)
+    Y3 = F.add(t0, t2, p)
+    Z3 = F.mul(t1, Z3, p)
+    t0 = F.sub(t0, F.mul_const(t2, 3, p), p)
+    Y3 = F.mul(t0, Y3, p)
+    Y3 = F.add(X3, Y3, p)
+    t1 = F.mul(X, Y, p)
+    X3 = F.mul_const(F.mul(t0, t1, p), 2, p)
+    return (X3, Y3, Z3)
+
+
 def shamir_ladder(bits1, bits2, P1, P2, curve: WeierstrassCurve):
-    """[k1]P1 + [k2]P2: interleaved double-and-add over complete additions
-    (doubling reuses the complete add — valid for all inputs)."""
+    """[k1]P1 + [k2]P2: interleaved double-and-add over complete additions."""
     batch_shape = P1[0].shape[:-1]
     P3 = add(P1, P2, curve)
     Pid = identity(batch_shape)
 
     def step(acc, bits):
         b1, b2 = bits
-        acc = add(acc, acc, curve)
-        idx = b1 + 2 * b2
-        sel = lambda c0, c1, c2, c3: F.select(
-            idx == 3, c3, F.select(idx == 2, c2, F.select(idx == 1, c1, c0)))
-        addend = tuple(sel(*cs) for cs in zip(Pid, P1, P2, P3))
+        acc = dbl(acc, curve)
+        addend = _select4(b1 + 2 * b2, (Pid, P1, P2, P3))
         return add(acc, addend, curve), None
 
     acc, _ = jax.lax.scan(step, Pid, (bits1.astype(jnp.uint64),
@@ -144,7 +222,7 @@ def glv_ladder(bits4, pts4, curve: WeierstrassCurve):
         table[t] = pt if rest == 0 else add(table[rest], pt, curve)
 
     def step(acc, bits):
-        acc = add(acc, acc, curve)
+        acc = dbl(acc, curve)
         level = table
         for j in range(4):                # fold by bit j (LSB first)
             b = bits[..., j].astype(jnp.bool_)
@@ -157,6 +235,16 @@ def glv_ladder(bits4, pts4, curve: WeierstrassCurve):
     return acc
 
 
+def _accept(X, Z, r_cands, p):
+    """ECDSA acceptance on the projective result: X/Z ≡ r_cand ⟺ X ≡ r_cand·Z
+    (homogeneous coordinates) — two field muls instead of a ~500-mul Fermat
+    inversion per batch; Z = 0 (infinity) rejected separately."""
+    nonzero = ~F.is_zero(Z, p)
+    ok_r = (F.eq(X, F.mul(r_cands[0], Z, p), p)
+            | F.eq(X, F.mul(r_cands[1], Z, p), p))
+    return nonzero & ok_r
+
+
 def verify_core_glv(bits4, pts4, r_cands):
     """secp256k1 ECDSA verify via the lambda endomorphism: the host splits
     u1 = a + b*lambda, u2 = c + d*lambda (ecmath.glv_decompose) and sign-
@@ -164,12 +252,8 @@ def verify_core_glv(bits4, pts4, r_cands):
     [|a|](±G) + [|b|](±phi(G)) + [|c|](±Q) + [|d|](±phi(Q)) in GLV_BITS
     iterations."""
     curve = CURVES["secp256k1"]
-    p = curve.p
     X, Y, Z = glv_ladder(bits4, pts4, curve)
-    nonzero = ~F.is_zero(Z, p)
-    x_aff = F.mul(X, F.inv(Z, p), p)
-    ok_r = F.eq(x_aff, r_cands[0], p) | F.eq(x_aff, r_cands[1], p)
-    return nonzero & ok_r
+    return _accept(X, Z, r_cands, curve.p)
 
 
 _verify_kernel_glv = jax.jit(verify_core_glv)
@@ -223,14 +307,117 @@ def prepare_batch_glv(items):
             pts_cols[j].append(pt)
     bits4 = np.stack([F.scalars_to_bits(scalars[j], GLV_BITS)
                       for j in range(4)], axis=-1)  # (GLV_BITS, B, 4)
-    pts4 = []
-    for col in pts_cols:
-        px = jnp.asarray(F.to_limbs([pt[0] for pt in col]))
-        py = jnp.asarray(F.to_limbs([pt[1] for pt in col]))
-        pz = jnp.zeros_like(px).at[..., 0].set(1)
-        pts4.append((px, py, pz))
+    pts4 = tuple(_points_to_limbs(col) for col in pts_cols)
     r_cands = jnp.asarray(np.stack([F.to_limbs(r0), F.to_limbs(r1)]))
-    return jnp.asarray(bits4), tuple(pts4), r_cands, precheck
+    return jnp.asarray(bits4), pts4, r_cands, precheck
+
+
+# ---------------------------------------------------------------------------
+# Hybrid GLV path (secp256k1): constant-table G legs + selected Q legs
+# ---------------------------------------------------------------------------
+
+_G_TABLES: dict[str, tuple] = {}
+
+
+def _g_sign_table(curve: WeierstrassCurve):
+    """(16, NLIMB)-per-coordinate constant projective table indexed by
+    ``ba + 2·bb + 4·sa + 8·sb``: entry = ba·(sa ? -G : G) + bb·(sb ? -phi(G)
+    : phi(G)). Identity rows are (0 : 1 : 0); the rest have Z = 1. G and
+    phi(G) are curve constants, so the whole table is baked into the kernel
+    and per-item rows come from one cheap device gather."""
+    if curve.name in _G_TABLES:
+        return _G_TABLES[curve.name]
+    p = curve.p
+    phi_g = (SECP256K1_BETA * curve.g[0] % p, curve.g[1])
+    xs, ys, zs = [], [], []
+    for idx in range(16):
+        ba, bb, sa, sb = idx & 1, (idx >> 1) & 1, (idx >> 2) & 1, (idx >> 3) & 1
+        pt = None
+        if ba:
+            pt = (curve.g[0], (p - curve.g[1]) % p) if sa else curve.g
+        if bb:
+            pg = (phi_g[0], (p - phi_g[1]) % p) if sb else phi_g
+            pt = curve.add(pt, pg)
+        xs.append(0 if pt is None else pt[0])
+        ys.append(1 if pt is None else pt[1])
+        zs.append(0 if pt is None else 1)
+    # Cache NUMPY constants: the first call may happen inside a jit trace, and
+    # caching trace-created jnp arrays would leak tracers into later traces
+    # (callers jnp.asarray per trace — a free constant).
+    tab = tuple(F.to_limbs(v) for v in (xs, ys, zs))
+    _G_TABLES[curve.name] = tab
+    return tab
+
+
+def hybrid_ladder(g_idx, bits_c, bits_d, Qc, Qd, curve: WeierstrassCurve):
+    """[|a|](±G) + [|b|](±phi G) + [c]Qc + [d]Qd over GLV_BITS iterations.
+
+    The G-side addend is gathered from the 16-entry *constant* sign table
+    (per-item signs folded into the index host-side); the Q-side addend is
+    the usual 4-way batched select over {1, Qc, Qd, Qc+Qd}. Versus
+    ``glv_ladder`` this replaces the 15-select binary tree with one gather
+    + 3 selects, at the cost of one extra complete add per iteration; versus
+    the plain 256-bit ``shamir_ladder`` it halves iteration count."""
+    batch_shape = Qc[0].shape[:-1]
+    Pid = identity(batch_shape)
+    Qcd = add(Qc, Qd, curve)
+    gtab = tuple(jnp.asarray(t) for t in _g_sign_table(curve))
+
+    def step(acc, ins):
+        gi, bc, bd = ins
+        acc = dbl(acc, curve)
+        g_addend = tuple(t[gi] for t in gtab)
+        acc = add(acc, g_addend, curve)
+        q_addend = _select4(bc + 2 * bd, (Pid, Qc, Qd, Qcd))
+        return add(acc, q_addend, curve), None
+
+    acc, _ = jax.lax.scan(step, Pid, (g_idx, bits_c.astype(jnp.uint64),
+                                      bits_d.astype(jnp.uint64)))
+    return acc
+
+
+def verify_core_hybrid(g_idx, bits_c, bits_d, Qc, Qd, r_cands):
+    curve = CURVES["secp256k1"]
+    X, Y, Z = hybrid_ladder(g_idx, bits_c, bits_d, Qc, Qd, curve)
+    return _accept(X, Z, r_cands, curve.p)
+
+
+_verify_kernel_hybrid = jax.jit(verify_core_hybrid)
+
+
+def prepare_batch_hybrid(items):
+    """Host prep for the hybrid kernel: GLV-decompose u1 (G legs: signs into
+    the gather index) and u2 (Q legs: signs folded into the points)."""
+    curve = CURVES["secp256k1"]
+    p = curve.p
+    precheck, pubs, u1s, u2s, r0, r1 = _precheck_and_scalars(curve, items)
+    sa, sb, abs_a, abs_b = [], [], [], []
+    cs, ds, qc_pts, qd_pts = [], [], [], []
+    for pub, u1, u2 in zip(pubs, u1s, u2s):
+        a, b = glv_decompose(u1)
+        c, d = glv_decompose(u2)
+        sa.append(a < 0)
+        sb.append(b < 0)
+        abs_a.append(abs(a))
+        abs_b.append(abs(b))
+        phi_q = (SECP256K1_BETA * pub[0] % p, pub[1])
+        for k, pt, ks, kpts in ((c, pub, cs, qc_pts), (d, phi_q, ds, qd_pts)):
+            if k < 0:
+                k, pt = -k, (pt[0], (p - pt[1]) % p)
+            ks.append(k)
+            kpts.append(pt)
+    bits_a = F.scalars_to_bits(abs_a, GLV_BITS)
+    bits_b = F.scalars_to_bits(abs_b, GLV_BITS)
+    g_idx = (bits_a + 2 * bits_b
+             + 4 * np.asarray(sa, dtype=np.uint32)[None, :]
+             + 8 * np.asarray(sb, dtype=np.uint32)[None, :]).astype(np.int32)
+
+    r_cands = jnp.asarray(np.stack([F.to_limbs(r0), F.to_limbs(r1)]))
+    return (jnp.asarray(g_idx),
+            jnp.asarray(F.scalars_to_bits(cs, GLV_BITS)),
+            jnp.asarray(F.scalars_to_bits(ds, GLV_BITS)),
+            _points_to_limbs(qc_pts), _points_to_limbs(qd_pts),
+            r_cands, precheck)
 
 
 def verify_core(u1_bits, u2_bits, q_pts, r_cands, curve_name: str):
@@ -246,12 +433,7 @@ def verify_core(u1_bits, u2_bits, q_pts, r_cands, curve_name: str):
     base = tuple(jnp.broadcast_to(_const(v, p), batch_shape + (F.NLIMB,))
                  for v in (curve.gx, curve.gy, 1))
     X, Y, Z = shamir_ladder(u1_bits, u2_bits, base, q_pts, curve)
-    nonzero = ~F.is_zero(Z, p)
-    # Affine x without division-by-zero hazard: Z=0 items are masked anyway,
-    # but inv(0)=0^(p-2)=0 keeps the lane well-defined.
-    x_aff = F.mul(X, F.inv(Z, p), p)
-    ok_r = F.eq(x_aff, r_cands[0], p) | F.eq(x_aff, r_cands[1], p)
-    return nonzero & ok_r
+    return _accept(X, Z, r_cands, p)
 
 
 _verify_kernel = jax.jit(verify_core, static_argnames=("curve_name",))
@@ -278,20 +460,32 @@ def prepare_batch(curve: WeierstrassCurve,
 
 def verify_batch(curve: WeierstrassCurve,
                  items: list[tuple[tuple[int, int] | None, bytes, int, int]],
-                 use_glv: bool = False) -> np.ndarray:
+                 mode: str = "auto") -> np.ndarray:
     """Batched ECDSA verify: [(pub_affine, msg, r, s)] → bool verdicts (B,).
 
     Pads to a power-of-two bucket (replicating the last item) so the device
-    kernel compiles once per bucket size. ``use_glv`` switches secp256k1 to
-    the half-length endomorphism ladder — measured at parity with the plain
-    ladder on current hardware (the 16-way table select costs what the saved
-    point operations buy back; see glv_ladder), so the plain path is the
-    default until the select is cheaper."""
+    kernel compiles once per bucket size. ``mode``:
+    - "auto": the fastest measured path — "hybrid" for secp256k1, "plain"
+      otherwise (no endomorphism on r1).
+    - "hybrid": GLV half-length ladder with the constant-G gather table.
+    - "glv": the all-select GLV ladder (kept for differential testing —
+      measured at parity with plain: the 15-select tree eats the saved ops).
+    - "plain": the 256-bit two-scalar Shamir ladder.
+    """
     n = len(items)
     if n == 0:
         return np.zeros(0, dtype=bool)
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
-    if use_glv and curve.name == "secp256k1":
+    if mode == "auto":
+        mode = "hybrid" if curve.name == "secp256k1" else "plain"
+    if mode not in ("plain", "glv", "hybrid"):
+        raise ValueError(f"unknown verify mode {mode!r}")
+    if mode != "plain" and curve.name != "secp256k1":
+        raise ValueError(f"mode {mode!r} requires secp256k1")
+    if mode == "hybrid":
+        *args, precheck = prepare_batch_hybrid(padded)
+        ok = np.asarray(_verify_kernel_hybrid(*args))
+    elif mode == "glv":
         bits4, pts4, r_cands, precheck = prepare_batch_glv(padded)
         ok = np.asarray(_verify_kernel_glv(bits4, pts4, r_cands))
     else:

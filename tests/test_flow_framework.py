@@ -225,6 +225,76 @@ def test_send_and_receive_with_retry(net):
     assert _GRUMPY_COUNT["n"] == 3
 
 
+@initiating_flow
+class RetryThenChatFlow(FlowLogic):
+    """Retry exchange (peer fails once) followed by a second exchange on the
+    same post-retry session — the restart-replay regression case."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        first = yield from self.send_and_receive_with_retry(self.peer, "ping",
+                                                            str, attempts=3)
+        second = yield SendAndReceive(self.peer, "again", str)
+        return (first.unwrap(lambda d: d), second.unwrap(lambda d: d))
+
+
+_FLAKY_COUNT = {"n": 0}
+
+
+@initiated_by(RetryThenChatFlow)
+class FlakyThenChatty(FlowLogic):
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        msg = yield Receive(self.peer, str)
+        _FLAKY_COUNT["n"] += 1
+        if _FLAKY_COUNT["n"] < 2:
+            raise FlowException("not yet")
+        assert msg.unwrap(lambda d: d) == "ping"
+        yield Send(self.peer, "pong")
+        msg2 = yield Receive(self.peer, str)
+        assert msg2.unwrap(lambda d: d) == "again"
+        yield Send(self.peer, "pong2")
+        return None
+
+
+def test_retry_discard_not_replayed_on_restart(tmp_path):
+    """Restart a flow that already survived a session-failure retry and is
+    parked on a LATER exchange with the same party: replaying the logged
+    error must not re-run discard_session against the restored live session
+    (which would orphan the parked receive)."""
+    network = MockNetwork()
+    a = network.create_node(
+        "O=Alice, L=London, C=GB",
+        checkpoint_storage=FileCheckpointStorage(str(tmp_path / "a_ckpts")))
+    b = network.create_node("O=Bob, L=Paris, C=FR")
+    network.start_nodes()
+    _FLAKY_COUNT["n"] = 0
+
+    fsm = a.start_flow(RetryThenChatFlow(b.party))
+    alice, bob = str(a.party.name), str(b.party.name)
+    # drive until the retry succeeded and Alice parked on the second receive
+    # (the resume that logs the 'data' entry also sends "again" synchronously)
+    for _ in range(50):
+        if any(e[0] == "data" for e in fsm.response_log):
+            break
+        network.bus.pump_receive(bob)
+        network.bus.pump_receive(alice)
+    else:
+        raise AssertionError("never reached the second exchange")
+    network.run_network(exclude=(alice,))  # Bob answers "again" → stays queued
+
+    a2 = a.restart()  # Alice dies and comes back mid-second-exchange
+    a2.start()
+    restored = list(a2.smm.flows.values())
+    assert len(restored) == 1
+    network.run_network()
+    assert restored[0].result_future.result(timeout=1) == ("pong", "pong2")
+
+
 def test_flow_completion_removes_checkpoints(net):
     network, a, b = net
     a.start_flow(PingFlow(b.party))

@@ -109,18 +109,30 @@ def test_wc_add_matches_host(curve):
         x, y, z = F.from_limbs(X[i]), F.from_limbs(Y[i]), F.from_limbs(Z[i])
         zi = pow(z, curve.p - 2, curve.p)
         assert (x * zi % curve.p, y * zi % curve.p) == want
+    # dedicated doubling formula (incl. the identity edge case)
+    Ib = tuple(np.asarray(c) for c in wc_ops.identity((1,)))
+    Db = tuple(np.concatenate([np.asarray(c), i_c])
+               for c, i_c in zip(Pb, Ib))
+    X, Y, Z = wc_ops.dbl(Db, curve)
+    for i, pa in enumerate(pts):
+        want = curve.add(pa, pa)
+        x, y, z = F.from_limbs(X[i]), F.from_limbs(Y[i]), F.from_limbs(Z[i])
+        zi = pow(z, curve.p - 2, curve.p)
+        assert (x * zi % curve.p, y * zi % curve.p) == want
+    assert F.from_limbs(Z[len(pts)]) % curve.p == 0  # 2·identity = identity
 
 
 @pytest.mark.parametrize(
-    "curve,use_glv",
-    [(ecmath.SECP256K1, False),
-     (ecmath.SECP256K1, True),   # endomorphism half-ladder path
+    "curve,mode",
+    [(ecmath.SECP256K1, "plain"),
+     (ecmath.SECP256K1, "glv"),      # endomorphism all-select ladder
+     (ecmath.SECP256K1, "hybrid"),   # endomorphism + constant-G gather table
      # r1's 224-bit Solinas fold constant makes its kernel a multi-minute XLA
      # compile; the shared kernel code is covered by k1, and r1 point math by
      # test_wc_add_matches_host.
-     pytest.param(ecmath.SECP256R1, False, marks=pytest.mark.slow)],
-    ids=lambda v: v.name if hasattr(v, "name") else ("glv" if v else "plain"))
-def test_ecdsa_verify_batch(curve, use_glv):
+     pytest.param(ecmath.SECP256R1, "plain", marks=pytest.mark.slow)],
+    ids=lambda v: v if isinstance(v, str) else v.name)
+def test_ecdsa_verify_batch(curve, mode):
     items, want = [], []
     for i in range(8):
         priv = rand_scalar(curve.n - 1) + 1
@@ -135,7 +147,7 @@ def test_ecdsa_verify_batch(curve, use_glv):
             pub = curve.mul(rand_scalar(curve.n - 1) + 1, curve.g)
         items.append((pub, msg, r, s))
         want.append(ecmath.ecdsa_verify(curve, pub, msg, r, s))
-    got = wc_ops.verify_batch(curve, items, use_glv=use_glv)
+    got = wc_ops.verify_batch(curve, items, mode=mode)
     assert list(got) == want
     assert want[0] and not all(want)
 
