@@ -26,7 +26,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 from corda_tpu.core.crypto import ecmath
 from corda_tpu.ops import weierstrass as wc_ops
 
-BATCH = 8192    # throughput saturates past ~8k (fixed dispatch cost amortized)
+BATCH = 32768  # throughput peaks near 32k (dispatch amortized; 64k regresses)
 UNIQUE = 512    # distinct signatures (host signing is pure Python; tile up)
 REPS = 3
 

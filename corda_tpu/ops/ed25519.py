@@ -52,7 +52,7 @@ def add(Pt, Qt):
     x1, y1, z1, t1 = Pt
     x2, y2, z2, t2 = Qt
     a = F.mul(F.sub(y1, x1, P), F.sub(y2, x2, P), P)
-    b = F.mul(F.add(y1, x1, P), F.add(y2, x2, P), P)
+    b = F.mul_of_sums(y1, x1, y2, x2, P)
     c = F.mul(F.mul(t1, _const(_D2), P), t2, P)
     d = F.mul_const(F.mul(z1, z2, P), 2, P)
     e = F.sub(b, a, P)
@@ -69,7 +69,7 @@ def double(Pt):
     b = F.sqr(y1, P)
     c = F.mul_const(F.sqr(z1, P), 2, P)
     h = F.add(a, b, P)
-    e = F.sub(h, F.sqr(F.add(x1, y1, P), P), P)
+    e = F.sub(h, F.sqr_of_sum(x1, y1, P), P)
     g = F.sub(a, b, P)
     f = F.add(c, g, P)
     return (F.mul(e, f, P), F.mul(g, h, P), F.mul(f, g, P), F.mul(e, h, P))

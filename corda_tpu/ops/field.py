@@ -206,15 +206,19 @@ def canon(a, p: int):
 # All take and return contract elements (see module docstring).
 # ---------------------------------------------------------------------------
 
-def raw_mul_bounded(a, b):
-    """Full product with exact column bounds: contract × contract → wide."""
+def raw_mul_bounded(a, b, a_bounds=None, b_bounds=None):
+    """Full product with exact column bounds: bounded × bounded → wide.
+    Input bounds default to the contract; callers passing *relaxed* operands
+    (e.g. un-normalized sums) supply their exact bounds instead."""
+    a_bounds = _CONTRACT if a_bounds is None else a_bounds
+    b_bounds = _CONTRACT if b_bounds is None else b_bounds
     cols = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
                      + (2 * NLIMB - 1,), dtype=jnp.uint64)
     for i in range(NLIMB):
         cols = cols.at[..., i:i + NLIMB].add(a[..., i:i + 1] * b)
     nb = [0] * (2 * NLIMB - 1)
-    for i, ab in enumerate(_CONTRACT):
-        for j, bb in enumerate(_CONTRACT):
+    for i, ab in enumerate(a_bounds):
+        for j, bb in enumerate(b_bounds):
             nb[i + j] += ab * bb
     assert max(nb) < (1 << 63), "u64 column overflow in schoolbook multiply"
     return cols, nb
@@ -228,6 +232,26 @@ def mul(a, b, p: int):
 
 def sqr(a, p: int):
     return mul(a, a, p)
+
+
+_CONTRACT2 = [2 * c for c in _CONTRACT]
+
+
+def mul_of_sums(a1, a2, b1, b2, p: int):
+    """(a1+a2)·(b1+b2) mod p without normalizing the sums: the adds' carry
+    passes are absorbed into the product's own normalize (2×-contract input
+    bounds keep every u64 column far under 2^63 — asserted exactly). Shaves
+    two normalize walks off the (X1+Y1)(X2+Y2)-style cross terms that
+    dominate complete-addition formulas."""
+    cols, nb = raw_mul_bounded(a1 + a2, b1 + b2, _CONTRACT2, _CONTRACT2)
+    return _normalize(cols, nb, p)[0]
+
+
+def sqr_of_sum(a1, a2, p: int):
+    """(a1+a2)² mod p without normalizing the sum."""
+    s = a1 + a2
+    cols, nb = raw_mul_bounded(s, s, _CONTRACT2, _CONTRACT2)
+    return _normalize(cols, nb, p)[0]
 
 
 def add(a, b, p: int):

@@ -89,19 +89,13 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     t0 = F.mul(X1, X2, p)
     t1 = F.mul(Y1, Y2, p)
     t2 = F.mul(Z1, Z2, p)
-    t3 = F.add(X1, Y1, p)
-    t4 = F.add(X2, Y2, p)
-    t3 = F.mul(t3, t4, p)
+    t3 = F.mul_of_sums(X1, Y1, X2, Y2, p)
     t4 = F.add(t0, t1, p)
     t3 = F.sub(t3, t4, p)
-    t4 = F.add(X1, Z1, p)
-    t5 = F.add(X2, Z2, p)
-    t4 = F.mul(t4, t5, p)
+    t4 = F.mul_of_sums(X1, Z1, X2, Z2, p)
     t5 = F.add(t0, t2, p)
     t4 = F.sub(t4, t5, p)
-    t5 = F.add(Y1, Z1, p)
-    X3 = F.add(Y2, Z2, p)
-    t5 = F.mul(t5, X3, p)
+    t5 = F.mul_of_sums(Y1, Z1, Y2, Z2, p)
     X3 = F.add(t1, t2, p)
     t5 = F.sub(t5, X3, p)
     if a == 0:
@@ -372,7 +366,7 @@ def hybrid_ladder(g_idx, bits_c, bits_d, Qc, Qd, curve: WeierstrassCurve):
         return add(acc, q_addend, curve), None
 
     acc, _ = jax.lax.scan(step, Pid, (g_idx, bits_c.astype(jnp.uint64),
-                                      bits_d.astype(jnp.uint64)))
+                                      bits_d.astype(jnp.uint64)), unroll=2)
     return acc
 
 
