@@ -21,7 +21,7 @@ from .base58 import b58encode
 from .secure_hash import SecureHash
 from .schemes import (
     SignatureScheme, RSA_SHA256, ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256,
-    EDDSA_ED25519_SHA512, DEFAULT_SIGNATURE_SCHEME,
+    EDDSA_ED25519_SHA512, SPHINCS256_SHA256, DEFAULT_SIGNATURE_SCHEME,
 )
 
 
@@ -149,6 +149,11 @@ def generate_keypair(scheme: SignatureScheme = DEFAULT_SIGNATURE_SCHEME,
             PublicKey(scheme, sec1_compress(curve, pub_pt)),
             PrivateKey(scheme, d.to_bytes(32, "big")),
         )
+    if sid == SPHINCS256_SHA256.scheme_number_id:
+        from . import sphincs
+        entropy = entropy if entropy is not None else os.urandom(32)
+        pub, priv = sphincs.keygen(entropy)
+        return KeyPair(PublicKey(scheme, pub), PrivateKey(scheme, priv))
     if sid == RSA_SHA256.scheme_number_id:
         from cryptography.hazmat.primitives.asymmetric import rsa
         from cryptography.hazmat.primitives import serialization

@@ -82,8 +82,8 @@ class Crypto:
             key = serialization.load_der_private_key(private.encoded, password=None)
             return key.sign(content, padding.PKCS1v15(), hashes.SHA256())
         if sid == SPHINCS256_SHA256.scheme_number_id:
-            raise SignatureException(
-                "SPHINCS-256 signing is not yet implemented in corda_tpu")
+            from . import sphincs
+            return sphincs.sign(private.encoded, content)
         raise SignatureException(f"Unsupported scheme for signing: {private.scheme}")
 
     @staticmethod
@@ -123,6 +123,9 @@ class Crypto:
                 return True
             except InvalidSignature:
                 return False
+        if sid == SPHINCS256_SHA256.scheme_number_id:
+            from . import sphincs
+            return sphincs.verify(public.encoded, content, signature)
         raise SignatureException(f"Unsupported scheme for verification: {public.scheme}")
 
     @staticmethod

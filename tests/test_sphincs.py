@@ -1,0 +1,52 @@
+"""SPHINCS-256 hash-based signatures — scheme #5 of the crypto layer.
+
+Reference analog: CryptoUtilsTest's per-scheme sign/verify roundtrip +
+malformed-input rejection for SPHINCS256_SHA512_256 (reference
+Crypto.kt:139-156). Construction details in corda_tpu/core/crypto/sphincs.py.
+"""
+import pytest
+
+from corda_tpu.core.crypto import sphincs
+from corda_tpu.core.crypto import (Crypto, SPHINCS256_SHA256, generate_keypair)
+
+
+@pytest.fixture(scope="module")
+def keypair():
+    return sphincs.keygen(b"\x2a" * 32)
+
+
+@pytest.fixture(scope="module")
+def signed(keypair):
+    pub, priv = keypair
+    msg = b"post-quantum ledger commitment"
+    return pub, msg, sphincs.sign(priv, msg)
+
+
+def test_roundtrip_and_tampering(signed):
+    pub, msg, sig = signed
+    assert len(sig) == sphincs.SIG_LEN
+    assert sphincs.verify(pub, msg, sig)
+    assert not sphincs.verify(pub, msg + b"!", sig)
+    # corrupt one byte in each structural region: R, HORST, WOTS, auth path
+    for off in (0, 40, sphincs.SIG_LEN - 40, sphincs.SIG_LEN // 2):
+        bad = bytearray(sig)
+        bad[off] ^= 1
+        assert not sphincs.verify(pub, msg, bytes(bad)), f"offset {off}"
+    assert not sphincs.verify(pub, msg, sig[:-1])        # truncated
+    other_pub, _ = sphincs.keygen(b"\x2b" * 32)
+    assert not sphincs.verify(other_pub, msg, sig)       # wrong key
+
+
+def test_keygen_deterministic():
+    assert sphincs.keygen(b"\x07" * 32) == sphincs.keygen(b"\x07" * 32)
+    assert sphincs.keygen(b"\x07" * 32) != sphincs.keygen(b"\x08" * 32)
+
+
+def test_crypto_facade_dispatch():
+    kp = generate_keypair(SPHINCS256_SHA256, entropy=b"\x11" * 32)
+    content = b"scheme dispatch through the Crypto facade"
+    sig = Crypto.sign_with_key(kp, content)
+    assert sig.verify(content)
+    assert sig.is_valid(content)
+    assert not sig.is_valid(content + b"x")
+    assert kp.public.scheme is SPHINCS256_SHA256
