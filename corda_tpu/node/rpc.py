@@ -119,6 +119,11 @@ class CordaRPCOps:
                     status: str = "unconsumed", **criteria) -> list:
         return self.hub.vault.query(state_type, status=status, **criteria)
 
+    def vault_query_by(self, criteria=None, paging=None, sorting=None):
+        """Full QueryCriteria query (reference CordaRPCOps.vaultQueryBy):
+        returns a node.query.Page with states + total count."""
+        return self.hub.vault.query_by(criteria, paging=paging, sorting=sorting)
+
     def vault_feed(self, state_type: type | None = None) -> DataFeed:
         def subscribe(cb):
             self.hub.vault.add_update_observer(cb)

@@ -8,15 +8,20 @@ flow completion (VaultSoftLockManager.kt).
 
 The SQL/Hibernate query engine of the reference maps here to predicate-based
 in-memory querying (the JDBC layer is a storage backend concern, not an API
-one); `query()` covers the QueryCriteria axes used by the finance layer:
-status, state type, owners, notary.
+one). Two query surfaces: `query()` covers the quick axes used by the finance
+layer (status, state type, owners, notary); `query_by()` is the full
+QueryCriteria engine (node.query) with linear/fungible/custom criteria,
+And/Or composition, time conditions, soft-lock filters, paging and sorting.
 """
 from __future__ import annotations
 
+import datetime as _dt
 import threading
 from dataclasses import dataclass, field
 
 from ..core.contracts.structures import StateAndRef, StateRef
+from .query import (Page, PageSpecification, QueryCriteria, Sort, VaultRecord,
+                    run_query)
 
 
 @dataclass(frozen=True)
@@ -36,11 +41,14 @@ class SoftLockError(Exception):
 
 
 class NodeVaultService:
-    def __init__(self, hub):
+    def __init__(self, hub, clock=None):
         self.hub = hub
+        self.clock = clock or (lambda: _dt.datetime.now(_dt.timezone.utc))
         self._lock = threading.Lock()
         self._unconsumed: dict[StateRef, StateAndRef] = {}
         self._consumed: dict[StateRef, StateAndRef] = {}
+        self._recorded_time: dict[StateRef, _dt.datetime] = {}
+        self._consumed_time: dict[StateRef, _dt.datetime] = {}
         self._soft_locks: dict[StateRef, str] = {}      # ref -> lock id (flow id)
         self._observers: list = []
 
@@ -57,11 +65,13 @@ class NodeVaultService:
         for stx in txs:
             wtx = stx.tx if hasattr(stx, "tx") else stx
             with self._lock:
+                now = self.clock()
                 consumed = []
                 for ref in wtx.inputs:
                     sar = self._unconsumed.pop(ref, None)
                     if sar is not None:
                         self._consumed[ref] = sar
+                        self._consumed_time[ref] = now
                         self._soft_locks.pop(ref, None)
                         consumed.append(sar)
                 produced = []
@@ -69,6 +79,7 @@ class NodeVaultService:
                     if self._is_relevant(out):
                         sar = StateAndRef(out, StateRef(wtx.id, i))
                         self._unconsumed[sar.ref] = sar
+                        self._recorded_time[sar.ref] = now
                         produced.append(sar)
             update = VaultUpdate(tuple(consumed), tuple(produced))
             if not update.is_empty:
@@ -113,6 +124,24 @@ class NodeVaultService:
                             continue
                     out.append(sar)
             return out
+
+    def query_by(self, criteria: QueryCriteria | None = None,
+                 paging: PageSpecification | None = None,
+                 sorting: Sort | None = None) -> Page:
+        """Full QueryCriteria engine (reference vaultQueryBy): composable
+        criteria + paging + sorting over all vault records. See node.query
+        for the criteria classes."""
+        with self._lock:
+            records = [
+                VaultRecord(sar, "unconsumed", self._recorded_time.get(ref),
+                            None, self._soft_locks.get(ref))
+                for ref, sar in self._unconsumed.items()
+            ] + [
+                VaultRecord(sar, "consumed", self._recorded_time.get(ref),
+                            self._consumed_time.get(ref), None)
+                for ref, sar in self._consumed.items()
+            ]
+        return run_query(records, criteria, paging, sorting)
 
     # -- soft locking (NodeVaultService :261-296) ----------------------------
     def soft_lock_reserve(self, lock_id: str, refs) -> None:

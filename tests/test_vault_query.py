@@ -1,0 +1,124 @@
+"""Vault QueryCriteria engine: composition, paging, sorting, time conditions.
+
+Reference analog: VaultQueryTests.kt (2,065 LoC exercising QueryCriteria.kt
+axes through vaultQueryBy) — here against the in-memory predicate engine
+(corda_tpu/node/query.py) over a cash ledger built with real flows.
+"""
+import pytest
+
+from corda_tpu.core.contracts.amount import Amount, GBP, USD
+from corda_tpu.finance import CashIssueFlow, CashPaymentFlow, CashState
+from corda_tpu.node.query import (FungibleAssetQueryCriteria,
+                                  CustomQueryCriteria, PageSpecification,
+                                  Sort, VaultQueryCriteria, VaultQueryError,
+                                  between, equal, greater_than,
+                                  greater_than_or_equal, less_than)
+from corda_tpu.testing import MockNetwork
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    bank = network.create_node("O=Bank, L=London, C=GB")
+    alice = network.create_node("O=Alice, L=Madrid, C=ES")
+    network.start_nodes()
+    for qty, ccy, ref in ((100, USD, b"\x01"), (250, USD, b"\x02"),
+                          (40, GBP, b"\x03")):
+        fsm = bank.start_flow(CashIssueFlow(Amount(qty * 100, ccy), ref,
+                                            bank.party, notary.party))
+        network.run_network()
+        fsm.result_future.result(timeout=1)
+    return network, notary, bank, alice
+
+
+def test_status_and_type_axes(net):
+    network, notary, bank, alice = net
+    page = bank.services.vault.query_by(
+        VaultQueryCriteria(contract_state_types=(CashState,)))
+    assert page.total_states_available == 3
+    # consume one by paying alice
+    fsm = bank.start_flow(CashPaymentFlow(Amount(100 * 100, USD), alice.party))
+    network.run_network()
+    fsm.result_future.result(timeout=1)
+    consumed = bank.services.vault.query_by(VaultQueryCriteria(status="consumed"))
+    assert consumed.total_states_available >= 1
+    everything = bank.services.vault.query_by(VaultQueryCriteria(status="all"))
+    assert everything.total_states_available > consumed.total_states_available
+
+
+def test_fungible_criteria_quantity_and_issuer(net):
+    network, notary, bank, alice = net
+    vault = bank.services.vault
+    big = vault.query_by(FungibleAssetQueryCriteria(
+        quantity=greater_than(100 * 100)))
+    assert [s.state.data.amount.quantity
+            for s in big.states] == [250 * 100]
+    small = vault.query_by(FungibleAssetQueryCriteria(
+        quantity=less_than(50 * 100)))
+    assert [s.state.data.amount.quantity for s in small.states] == [40 * 100]
+    ref2 = vault.query_by(FungibleAssetQueryCriteria(issuer_ref=(b"\x02",)))
+    assert ref2.total_states_available == 1
+    issuer = vault.query_by(FungibleAssetQueryCriteria(issuer=(bank.party,)))
+    assert issuer.total_states_available == 3
+    rng = vault.query_by(FungibleAssetQueryCriteria(
+        quantity=between(40 * 100, 100 * 100)))
+    assert rng.total_states_available == 2
+
+
+def test_custom_criteria_and_composition(net):
+    network, notary, bank, alice = net
+    vault = bank.services.vault
+    usd = CustomQueryCriteria(attribute="amount.token.product.code",
+                              predicate=equal("USD"))
+    big = FungibleAssetQueryCriteria(quantity=greater_than_or_equal(100 * 100))
+    both = vault.query_by(usd & big)
+    assert both.total_states_available == 2
+    either = vault.query_by(
+        CustomQueryCriteria(attribute="amount.token.product.code",
+                            predicate=equal("GBP")) | big)
+    assert either.total_states_available == 3
+
+
+def test_sorting_and_paging(net):
+    network, notary, bank, alice = net
+    vault = bank.services.vault
+    page = vault.query_by(
+        VaultQueryCriteria(),
+        sorting=Sort((("quantity", "DESC"),)))
+    qtys = [s.state.data.amount.quantity for s in page.states]
+    assert qtys == sorted(qtys, reverse=True)
+    p1 = vault.query_by(VaultQueryCriteria(),
+                        paging=PageSpecification(1, 2),
+                        sorting=Sort((("quantity", "ASC"),)))
+    p2 = vault.query_by(VaultQueryCriteria(),
+                        paging=PageSpecification(2, 2),
+                        sorting=Sort((("quantity", "ASC"),)))
+    assert p1.total_states_available == 3 and p2.total_states_available == 3
+    assert len(p1.states) == 2 and len(p2.states) == 1
+    all_q = ([s.state.data.amount.quantity for s in p1.states]
+             + [s.state.data.amount.quantity for s in p2.states])
+    assert all_q == sorted(all_q)
+    with pytest.raises(VaultQueryError):
+        PageSpecification(0, 10)
+
+
+def test_time_condition_and_soft_lock_axes(net):
+    network, notary, bank, alice = net
+    vault = bank.services.vault
+    from corda_tpu.node.query import TimeCondition
+    import datetime
+    now = datetime.datetime.now(datetime.timezone.utc)
+    past = vault.query_by(VaultQueryCriteria(time_condition=TimeCondition(
+        "recorded", less_than(now + datetime.timedelta(minutes=1)))))
+    assert past.total_states_available == 3
+    future = vault.query_by(VaultQueryCriteria(time_condition=TimeCondition(
+        "recorded", greater_than(now + datetime.timedelta(minutes=1)))))
+    assert future.total_states_available == 0
+    # soft-lock one state; locked/unlocked filters partition the vault
+    sar = vault.unconsumed_states(CashState)[0]
+    vault.soft_lock_reserve("flow-1", [sar.ref])
+    locked = vault.query_by(VaultQueryCriteria(soft_locking="locked_only"))
+    unlocked = vault.query_by(VaultQueryCriteria(soft_locking="unlocked_only"))
+    assert locked.total_states_available == 1
+    assert unlocked.total_states_available == 2
