@@ -29,14 +29,23 @@ class FlowFailedException(RPCException):
 
 class CordaRPCClient:
     def __init__(self, host: str, port: int, client_host: str = "127.0.0.1",
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, tls_ca_directory: str | None = None):
+        """``tls_ca_directory``: enable mutual TLS against a node whose plane
+        runs the dev CA in that directory (the client auto-provisions its own
+        CA-signed certificate there, like any other peer)."""
         self.node_addr = (host, port)
         self.timeout_s = timeout_s
         self._pending: dict[str, object] = {}
         self._cond = threading.Condition()
+        name = f"rpc-client-{uuid.uuid4().hex[:8]}"
+        tls = None
+        if tls_ca_directory is not None:
+            import tempfile
+            from ..network.tls import TlsConfig
+            tls = TlsConfig.dev(tempfile.mkdtemp(prefix="rpc-tls-"), name,
+                                tls_ca_directory)
         self._messaging = TcpMessagingService(
-            f"rpc-client-{uuid.uuid4().hex[:8]}", client_host, 0,
-            lambda name: self.node_addr)
+            name, client_host, 0, lambda name: self.node_addr, tls=tls)
         self._messaging.add_message_handler(TopicSession(TOPIC_RPC, 1),
                                             self._on_response)
         self.reply_to = f"{client_host}:{self._messaging.port}"

@@ -12,6 +12,13 @@ Wire frame: 4-byte big-endian length + canonical-codec bytes of
 and replayed on handler registration (NodeMessagingClient retention), and
 sends to unreachable peers are retried with a delay
 (messageRedeliveryDelaySeconds analog).
+
+Security: pass a ``network.tls.TlsConfig`` to run the plane over mutual TLS —
+both sides must present certificates chained to the shared CA
+(ArtemisTcpTransport parity). Backpressure: per-peer outbound queues are
+bounded; when a peer falls MAX_PENDING_FRAMES behind, the *sending* thread
+blocks (the broker-producer-blocking semantics) until space frees or the
+overflow timeout trips, at which point the frame is dropped with an error.
 """
 from __future__ import annotations
 
@@ -30,6 +37,8 @@ log = logging.getLogger(__name__)
 MAX_FRAME = 64 * 1024 * 1024
 REDELIVERY_DELAY_S = 0.5
 MAX_SEND_ATTEMPTS = 10
+MAX_PENDING_FRAMES = 10_000       # per-peer outbound bound (backpressure)
+BACKPRESSURE_TIMEOUT_S = 30.0
 
 
 class TcpMessagingService(MessagingService):
@@ -42,10 +51,11 @@ class TcpMessagingService(MessagingService):
 
     def __init__(self, my_name: str, host: str, port: int,
                  resolve_address: Callable[[str], tuple | None],
-                 executor: SerialExecutor | None = None):
+                 executor: SerialExecutor | None = None, tls=None):
         self._name = my_name
         self.host = host
         self.port = port
+        self.tls = tls                      # network.tls.TlsConfig | None
         self.resolve_address = resolve_address
         self.executor = executor if executor is not None else SerialExecutor(
             f"node-thread({my_name})")
@@ -71,7 +81,8 @@ class TcpMessagingService(MessagingService):
 
     async def _start_server(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle_connection, self.host, self.port)
+            self._handle_connection, self.host, self.port,
+            ssl=self.tls.server_ctx if self.tls is not None else None)
         if self.port == 0:  # ephemeral: learn the kernel-assigned port
             self.port = self._server.sockets[0].getsockname()[1]
 
@@ -115,18 +126,28 @@ class TcpMessagingService(MessagingService):
         frame_body = serialize([topic_session.topic, topic_session.session_id,
                                 self._name, payload])
         frame = len(frame_body).to_bytes(4, "big") + frame_body
-        self._loop.call_soon_threadsafe(self._enqueue_send, recipient, frame)
+        fut = asyncio.run_coroutine_threadsafe(
+            self._enqueue_send(recipient, frame), self._loop)
+        try:
+            # backpressure: a full per-peer queue blocks the producer here
+            fut.result(timeout=BACKPRESSURE_TIMEOUT_S)
+        except TimeoutError:
+            fut.cancel()
+            log.error("dropping frame to %s: outbound queue full for %.0fs",
+                      recipient, BACKPRESSURE_TIMEOUT_S)
 
-    def _enqueue_send(self, recipient: str, frame: bytes) -> None:
-        """One outbound queue + sender task per recipient: frames to a peer
-        stay ordered (the per-peer broker queue semantics) and exactly one
-        connection per peer exists — no open_connection races."""
+    async def _enqueue_send(self, recipient: str, frame: bytes) -> None:
+        """One *bounded* outbound queue + sender task per recipient: frames
+        to a peer stay ordered (the per-peer broker queue semantics), exactly
+        one connection per peer exists, and a slow peer eventually blocks its
+        producers instead of growing memory without bound."""
         q = self._send_queues.get(recipient)
         if q is None:
-            q = self._send_queues[recipient] = asyncio.Queue()
+            q = self._send_queues[recipient] = asyncio.Queue(
+                maxsize=MAX_PENDING_FRAMES)
             self._sender_tasks[recipient] = self._loop.create_task(
                 self._sender(recipient, q))
-        q.put_nowait(frame)
+        await q.put(frame)
 
     async def _sender(self, recipient: str, q: "asyncio.Queue") -> None:
         while True:
@@ -152,7 +173,8 @@ class TcpMessagingService(MessagingService):
         if addr is None:
             raise LookupError(f"no address known for {recipient!r}")
         host, port = addr
-        _, writer = await asyncio.open_connection(host, port)
+        _, writer = await asyncio.open_connection(
+            host, port, ssl=self.tls.client_ctx if self.tls is not None else None)
         self._writers[recipient] = writer
         return writer
 

@@ -50,6 +50,10 @@ class NodeConfiguration:
     notary: str | None = None          # None | "simple" | "validating"
     verifier_type: str = "InMemory"    # InMemory | Tpu | OutOfProcess
     key_seed_hex: str | None = None    # deterministic identity (tests)
+    tls: bool = False                  # mutual TLS on the TCP plane
+    # shared dev-CA directory (all nodes of one network must agree);
+    # default: a "dev-ca" sibling of base_directory
+    tls_ca_directory: str | None = None
     # modules imported at boot so their @startable_by_rpc / @initiated_by
     # registrations load — the cordapp classpath scan (AbstractNode.kt:201-206)
     cordapps: list = field(default_factory=lambda: ["corda_tpu.finance"])
@@ -92,9 +96,16 @@ class Node:
         self.key_pair = self._load_or_create_identity()
         self.party = Party(config.my_legal_name, self.key_pair.public)
         self.executor = SerialExecutor(f"node-thread({config.my_legal_name})")
+        tls_config = None
+        if config.tls:
+            from ..network.tls import TlsConfig
+            ca_dir = config.tls_ca_directory or os.path.join(
+                os.path.dirname(os.path.abspath(config.base_directory)), "dev-ca")
+            tls_config = TlsConfig.dev(config.base_directory,
+                                       str(self.party.name), ca_dir)
         self.messaging = TcpMessagingService(
             str(self.party.name), config.host, config.port,
-            self._resolve_address, executor=self.executor)
+            self._resolve_address, executor=self.executor, tls=tls_config)
 
         services = ()
         if config.notary == "simple":
