@@ -153,11 +153,13 @@ class Node:
 
     def _make_verifier(self):
         from ..verifier.service import make_verifier_service
+        metrics = self.services.monitoring
         if self.config.verifier_type == "OutOfProcess":
             from ..verifier.out_of_process import (
                 OutOfProcessTransactionVerifierService)
-            return OutOfProcessTransactionVerifierService(self.messaging)
-        return make_verifier_service(self.config.verifier_type)
+            return OutOfProcessTransactionVerifierService(self.messaging,
+                                                          metrics=metrics)
+        return make_verifier_service(self.config.verifier_type, metrics=metrics)
 
     def _make_notary(self):
         if self.config.notary is None:

@@ -124,6 +124,12 @@ class CordaRPCOps:
         returns a node.query.Page with states + total count."""
         return self.hub.vault.query_by(criteria, paging=paging, sorting=sorting)
 
+    # -- monitoring ----------------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        """The node's metric registry (the JMX-export analog: verification
+        timers/meters, batcher counters, flow rates)."""
+        return self.hub.monitoring.snapshot()
+
     def vault_feed(self, state_type: type | None = None) -> DataFeed:
         def subscribe(cb):
             self.hub.vault.add_update_observer(cb)

@@ -209,8 +209,12 @@ class ServiceHub:
 
     def __init__(self, my_info: NodeInfo, network_service,
                  key_pairs=(), verifier_service=None):
+        from ..utils.metrics import MetricRegistry
         self.my_info = my_info
         self.network_service = network_service
+        # the node-wide metric registry (MonitoringService.kt:11 parity);
+        # the verifier service and SMM publish into it, /metrics exports it
+        self.monitoring = MetricRegistry()
         self.storage = TransactionStorage()
         self.key_management = KeyManagementService(key_pairs)
         self.identity_service = InMemoryIdentityService([my_info.legal_identity])

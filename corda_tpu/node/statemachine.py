@@ -155,6 +155,10 @@ class StateMachineManager:
         return fsm
 
     def _register(self, fsm: FlowStateMachine) -> None:
+        monitoring = getattr(self.hub, "monitoring", None)
+        if monitoring is not None:   # Flows.StartedPerSecond analog
+            monitoring.meter("Flows.Started").mark()
+            monitoring.counter("Flows.InFlight").inc()
         self.flows[fsm.run_id] = fsm
         fsm.flow.state_machine = fsm
         fsm.flow.service_hub = self.hub
@@ -488,6 +492,10 @@ class StateMachineManager:
         self._notify("remove", fsm)
 
     def _finalize(self, fsm: FlowStateMachine) -> None:
+        monitoring = getattr(self.hub, "monitoring", None)
+        if monitoring is not None and fsm.run_id in self.flows:
+            monitoring.meter("Flows.Finished").mark()
+            monitoring.counter("Flows.InFlight").dec()
         self.checkpoints.remove_checkpoint(fsm.run_id)
         self.flows.pop(fsm.run_id, None)
         self._cleanup_sessions(fsm)

@@ -10,6 +10,8 @@ app APIs and static content. Endpoints:
     GET  /api/vault             unconsumed states
     GET  /api/transactions      verified transaction ids
     GET  /api/flows             registered startable flows
+    GET  /api/metrics           metric registry snapshot (JSON)
+    GET  /metrics               same, Prometheus text exposition format
     POST /api/flows/<FlowName>  body: JSON list of args -> run id / result
 
 Values render through a JSON-ifier that understands the framework's types
@@ -18,8 +20,22 @@ Values render through a JSON-ifier that understands the framework's types
 from __future__ import annotations
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Metric snapshot → Prometheus text exposition (one gauge per numeric
+    field, metric names sanitized and prefixed corda_tpu_)."""
+    lines = []
+    for name, fields in sorted(snapshot.items()):
+        base = "corda_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", name).lower()
+        for k, v in fields.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            lines.append(f"{base}_{k} {v}")
+    return "\n".join(lines) + "\n"
 
 
 class RouteNotFound(Exception):
@@ -97,6 +113,19 @@ class NodeWebServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path == "/metrics":   # Prometheus scrape endpoint
+                    try:
+                        body = prometheus_text(server.ops.metrics_snapshot()
+                                               ).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/plain; version=0.0.4")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    except Exception as e:
+                        self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
                 try:
                     self._reply(200, server.handle_get(self.path))
                 except RouteNotFound:
@@ -143,6 +172,8 @@ class NodeWebServer:
                     for stx in self.ops.verified_transactions_snapshot()]
         if path == "/api/flows":
             return self.ops.registered_flows()
+        if path == "/api/metrics":
+            return self.ops.metrics_snapshot()
         raise RouteNotFound(path)
 
     def handle_post(self, path: str, args):

@@ -10,9 +10,15 @@ BASELINE.md config 2), and runs ONE batched kernel per scheme bucket.
 Latency/throughput trade: a flush triggers at ``max_batch`` items or after
 ``max_latency_s`` from the first queued item — the p50 @ batch=1 metric pulls
 against batch-size throughput (SURVEY.md §7 hard part 4).
+
+Profiling: set CORDA_TPU_PROFILE_DIR to capture a JAX profiler trace of the
+device dispatches (each batch is a named StepTraceAnnotation; view with
+TensorBoard / xprof). The reference's analog is YourKit/JMX on the verifier
+JVM (SURVEY.md §5 tracing).
 """
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import Future
 from dataclasses import dataclass, field
@@ -39,6 +45,14 @@ class _Pending:
     future: Future = field(default_factory=Future)
 
 
+class _null_ctx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
 class SignatureBatcher:
     """Accepts individual signature checks, returns Future[bool] verdicts,
     dispatches device-batched kernels per scheme from a background thread."""
@@ -53,6 +67,9 @@ class SignatureBatcher:
         self._queues: dict[str, list[_Pending]] = {
             "ed25519": [], "secp256k1": [], "secp256r1": [], "host": []}
         self._closed = False
+        self._profile_dir = os.environ.get("CORDA_TPU_PROFILE_DIR")
+        self._profiling = False
+        self._batch_seq = 0
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="sig-batcher")
         self._thread.start()
@@ -79,6 +96,10 @@ class SignatureBatcher:
             self._closed = True
             self._lock.notify()
         self._thread.join(timeout=5)
+        if self._profiling:
+            import jax
+            jax.profiler.stop_trace()
+            self._profiling = False
 
     # -- dispatcher ----------------------------------------------------------
     def _run(self) -> None:
@@ -103,8 +124,17 @@ class SignatureBatcher:
 
     def _dispatch(self, bucket: str, items: list[_Pending]) -> None:
         timer = self.metrics.timer(f"SigBatcher.{bucket}.Duration")
+        profile_ctx = None
+        if self._profile_dir is not None and bucket != "host":
+            import jax
+            if not self._profiling:
+                jax.profiler.start_trace(self._profile_dir)
+                self._profiling = True
+            self._batch_seq += 1
+            profile_ctx = jax.profiler.StepTraceAnnotation(
+                f"verify-{bucket}", step_num=self._batch_seq)
         try:
-            with timer:
+            with timer, (profile_ctx or _null_ctx()):
                 if bucket == "ed25519":
                     verdicts = self._run_ed25519(items)
                 elif bucket in ("secp256k1", "secp256r1"):

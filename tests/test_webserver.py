@@ -62,3 +62,14 @@ def test_rest_surface(web):
     # unknown endpoint → 404 error body
     with pytest.raises(urllib.error.HTTPError):
         _get(server, "/api/nope")
+
+    # metrics: the flow above marked the SMM meters; JSON + Prometheus text
+    metrics = _get(server, "/api/metrics")
+    assert metrics["Flows.Started"]["count"] >= 1
+    assert metrics["Flows.InFlight"]["value"] == 0
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=10) as r:
+        text = r.read().decode()
+        assert r.headers["Content-Type"].startswith("text/plain")
+    assert "corda_tpu_flows_started_count" in text
+    assert "corda_tpu_flows_inflight_value 0" in text
