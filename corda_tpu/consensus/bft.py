@@ -10,13 +10,26 @@ replicas agree.
 Protocol (PBFT normal case): client Request → primary PrePrepare(view, seq)
 → replicas Prepare → (2f matching) → Commit → (2f+1 matching) → execute in
 sequence order → Reply; the client waits for f+1 matching replies.
-View change is timeout-driven and simplified (documented): on 2f+1
-ViewChange votes the new primary re-proposes every request not yet executed
-— safe here because the notary state machine is idempotent per transaction
-id (re-committing the same tx id is a no-op, DistributedImmutableMap).
-Byzantine PRIMARY equivocation is detected by the prepare quorum; arbitrary
-byzantine replica behaviour beyond crash+equivocation is out of scope this
-round.
+
+View change (PBFT §4.4 shape, certificate-carrying): on timeout a replica
+broadcasts ViewChange carrying its *prepared certificates* — for every
+sequence it prepared, the PrePrepare plus ≥2f matching Prepare messages.
+The new primary assembles a 2f+1 ViewChange quorum into a NewView whose
+re-proposal order is a DETERMINISTIC function of the quorum (certificates
+sorted by (view, seq), deduplicated by request id); every replica
+re-derives that order from the embedded quorum and rejects a NewView (or a
+subsequent out-of-order PrePrepare) that deviates, voting the next view
+instead. Re-proposals take fresh sequence numbers above every sequence the
+quorum can have committed; the state machine's per-request idempotence
+makes re-execution of already-applied requests a no-op.
+
+Documented simplifications vs full PBFT: (a) message authenticity comes
+from the transport (mutual-TLS peer identity / the in-memory bus), not
+per-message signatures; (b) the stable-checkpoint + state-transfer
+subsystem is replaced by a certificate retention window
+(CERT_RETENTION executed sequences) — a correct replica lagging by more
+than the window needs state transfer, which is delegated to the layer
+above exactly as the reference delegates it to BFT-SMaRt's state transfer.
 """
 from __future__ import annotations
 
@@ -35,6 +48,8 @@ log = logging.getLogger(__name__)
 TOPIC_BFT = "platform.bft"
 
 VIEW_CHANGE_TICKS = 20
+CERT_RETENTION = 256   # executed seqs whose prepared certs are retained
+                       # (the stable-checkpoint-window analog)
 
 
 @dataclass(frozen=True)
@@ -77,19 +92,31 @@ class Reply:
 
 
 @dataclass(frozen=True)
+class PreparedCert:
+    """Proof that a sequence prepared: the PrePrepare plus ≥2f matching
+    Prepare messages from distinct replicas."""
+
+    pre_prepare: PrePrepare
+    prepares: tuple       # Prepare...
+
+
+@dataclass(frozen=True)
 class ViewChange:
     new_view: int
     replica: str
+    executed_through: int = -1
+    prepared: tuple = ()  # PreparedCert...
 
 
 @dataclass(frozen=True)
 class NewView:
     view: int
-    requests: tuple       # Request... to re-propose
+    view_changes: tuple   # the 2f+1 ViewChange quorum (the certificate)
+    requests: tuple       # re-proposal order — must re-derive from the quorum
 
 
-for _cls in (Request, PrePrepare, Prepare, CommitMsg, Reply, ViewChange,
-             NewView):
+for _cls in (Request, PrePrepare, Prepare, CommitMsg, Reply, PreparedCert,
+             ViewChange, NewView):
     register_type(f"bft.{_cls.__name__}", _cls)
 
 
@@ -113,12 +140,15 @@ class BFTReplica:
         self.next_seq = 0              # primary's sequence counter
         self.executed_through = -1
         self._log: dict[int, PrePrepare] = {}
-        self._prepares: dict[tuple, set] = {}
+        self._prepares: dict[tuple, dict[str, Prepare]] = {}
         self._commits: dict[tuple, set] = {}
         self._committed: dict[int, PrePrepare] = {}
+        self._prepared: dict[int, PreparedCert] = {}     # seq -> newest cert
         self._executed_requests: set = set()
         self._pending: dict[int, Request] = {}   # awaiting execution (by rid)
-        self._vc_votes: dict[int, set] = {}
+        self._vc_msgs: dict[int, dict[str, ViewChange]] = {}
+        self._nv_sent: set[int] = set()
+        self._expected_order: list = []   # request ids owed by a NewView
         self._ticks_waiting = 0
         self._lock = threading.RLock()
         messaging.add_message_handler(TopicSession(TOPIC_BFT), self._on_message)
@@ -155,7 +185,9 @@ class BFTReplica:
 
     def _vote_view_change(self, new_view: int) -> None:
         log.info("%s votes for view %d", self.replica_id, new_view)
-        self._broadcast(ViewChange(new_view, self.replica_id))
+        certs = tuple(cert for _, cert in sorted(self._prepared.items()))
+        self._broadcast(ViewChange(new_view, self.replica_id,
+                                   self.executed_through, certs))
 
     # -- message handling ----------------------------------------------------
     def _on_message(self, msg) -> None:
@@ -194,6 +226,13 @@ class BFTReplica:
             # on one digest while shipping different requests — reject it
             self._vote_view_change(self.view + 1)
             return
+        if self._expected_order:
+            # a NewView promised this exact re-proposal order; a primary that
+            # deviates from its own certificate is equivocating
+            expected = self._expected_order.pop(0)
+            if pp.request.request_id != expected:
+                self._vote_view_change(self.view + 1)
+                return
         existing = self._log.get(pp.seq)
         if existing is not None and existing.view == pp.view \
                 and existing.digest != pp.digest:
@@ -201,20 +240,27 @@ class BFTReplica:
             self._vote_view_change(self.view + 1)
             return
         self._log[pp.seq] = pp
-        self._pending.setdefault(pp.request.request_id, pp.request)
+        if pp.request.request_id not in self._executed_requests:
+            self._pending.setdefault(pp.request.request_id, pp.request)
         self._broadcast(Prepare(pp.view, pp.seq, pp.digest, self.replica_id))
 
     def _on_prepare(self, p: Prepare) -> None:
         if p.view != self.view:
             return
         key = (p.view, p.seq, p.digest)
-        votes = self._prepares.setdefault(key, set())
-        votes.add(p.replica)
+        votes = self._prepares.setdefault(key, {})
+        votes[p.replica] = p
         # prepared: matching preprepare + 2f prepares; commit once
         if len(votes) >= 2 * self.f and p.seq in self._log \
-                and self._log[p.seq].digest == p.digest \
-                and self.replica_id not in self._commits.get(key, set()):
-            self._broadcast(CommitMsg(p.view, p.seq, p.digest, self.replica_id))
+                and self._log[p.seq].digest == p.digest:
+            pp = self._log[p.seq]
+            held = self._prepared.get(p.seq)
+            if held is None or held.pre_prepare.view < pp.view:
+                self._prepared[p.seq] = PreparedCert(
+                    pp, tuple(sorted(votes.values(), key=lambda m: m.replica)))
+            if self.replica_id not in self._commits.get(key, set()):
+                self._broadcast(CommitMsg(p.view, p.seq, p.digest,
+                                          self.replica_id))
 
     def _on_commit(self, c: CommitMsg) -> None:
         if c.view != self.view:
@@ -232,10 +278,10 @@ class BFTReplica:
             self.executed_through += 1
             pp = self._committed[self.executed_through]
             req = pp.request
-            if req.request_id in self._executed_requests:
-                continue
-            self._executed_requests.add(req.request_id)
             self._pending.pop(req.request_id, None)
+            if req.request_id in self._executed_requests:
+                continue   # re-proposal of an already-applied request: no-op
+            self._executed_requests.add(req.request_id)
             self._ticks_waiting = 0
             try:
                 result, error = self.apply_fn(req.entry), None
@@ -248,7 +294,9 @@ class BFTReplica:
     def _gc(self, through: int) -> None:
         """Prune per-sequence protocol state at/below the executed watermark
         (the minimal stable-checkpoint analog) so replica memory tracks the
-        state machine, not total historical throughput."""
+        state machine, not total historical throughput. Prepared certificates
+        are retained for CERT_RETENTION extra sequences so view changes can
+        still re-propose recently executed requests to lagging replicas."""
         self._log = {s: pp for s, pp in self._log.items() if s > through}
         self._committed = {s: pp for s, pp in self._committed.items()
                            if s > through}
@@ -256,44 +304,118 @@ class BFTReplica:
                           if k[1] > through}
         self._commits = {k: v for k, v in self._commits.items()
                          if k[1] > through}
+        self._prepared = {s: c for s, c in self._prepared.items()
+                          if s > through - CERT_RETENTION}
 
-    # -- view change (simplified; see module docstring) ----------------------
+    # -- view change (certificate-carrying; see module docstring) ------------
+    def _derive_requests(self, view_changes) -> tuple | None:
+        """The deterministic re-proposal order a ViewChange quorum implies:
+        validated prepared certificates sorted by (view, seq), deduplicated
+        by request id. None if any certificate fails validation."""
+        certs = []
+        for vc in view_changes:
+            for cert in vc.prepared:
+                pp = cert.pre_prepare
+                if pp.digest != _digest(pp.request):
+                    return None
+                voters = {p.replica for p in cert.prepares
+                          if (p.view, p.seq, p.digest)
+                          == (pp.view, pp.seq, pp.digest)}
+                if len(voters) < 2 * self.f:
+                    return None
+                certs.append(cert)
+        certs.sort(key=lambda c: (c.pre_prepare.view, c.pre_prepare.seq))
+        seen, out = set(), []
+        for c in certs:
+            rid = c.pre_prepare.request.request_id
+            if rid not in seen:
+                seen.add(rid)
+                out.append(c.pre_prepare.request)
+        return tuple(out)
+
+    @staticmethod
+    def _safe_next_seq(view_changes) -> int:
+        """First sequence no member of the quorum can have committed below:
+        above every reported executed watermark and every certified seq."""
+        top = -1
+        for vc in view_changes:
+            top = max(top, vc.executed_through)
+            for cert in vc.prepared:
+                top = max(top, cert.pre_prepare.seq)
+        return top + 1
+
     def _on_view_change(self, vc: ViewChange) -> None:
         if vc.new_view <= self.view:
             return
-        votes = self._vc_votes.setdefault(vc.new_view, set())
-        votes.add(vc.replica)
+        msgs = self._vc_msgs.setdefault(vc.new_view, {})
+        msgs[vc.replica] = vc
         # PBFT join rule: co-vote once f+1 others want the change, regardless
         # of local pending state — otherwise a replica that never saw the
         # client request blocks the 2f+1 quorum at exactly 2f+1 live replicas
-        if self.replica_id not in votes and len(votes) >= self.f + 1:
-            votes.add(self.replica_id)
-            self._broadcast(ViewChange(vc.new_view, self.replica_id))
-        if len(votes) >= 2 * self.f + 1:
-            self._enter_view(vc.new_view)
-
-    def _enter_view(self, view: int) -> None:
-        self.view = view
-        self._ticks_waiting = 0
-        # un-executed slots from dead views must not collide with the new
-        # primary's fresh sequence assignment
-        self._log = {s: pp for s, pp in self._log.items()
-                     if s <= self.executed_through}
-        if self.is_primary:
-            # re-propose everything not yet executed (idempotent state machine)
-            reqs = tuple(self._pending.values())
-            log.info("%s is primary of view %d, re-proposing %d requests",
-                     self.replica_id, view, len(reqs))
-            self.next_seq = self.executed_through + 1
-            self._broadcast(NewView(view, reqs))
+        if self.replica_id not in msgs and len(msgs) >= self.f + 1:
+            self._vote_view_change(vc.new_view)
+            msgs = self._vc_msgs[vc.new_view]
+        if len(msgs) < 2 * self.f + 1:
+            return
+        if (self.replicas[vc.new_view % self.n] == self.replica_id
+                and vc.new_view not in self._nv_sent):
+            # I lead the new view: publish the quorum + derived order, then
+            # re-propose (certified requests first, my other pendings after)
+            self._nv_sent.add(vc.new_view)
+            quorum = tuple(msgs.values())
+            reqs = self._derive_requests(quorum)
+            if reqs is None:   # a peer shipped a bogus certificate
+                self._vote_view_change(vc.new_view + 1)
+                return
+            log.info("%s leads view %d: %d certified re-proposals",
+                     self.replica_id, vc.new_view, len(reqs))
+            self.view = vc.new_view
+            self._ticks_waiting = 0
+            self._expected_order = []
+            self._log = {s: pp for s, pp in self._log.items()
+                         if s <= self.executed_through}
+            # the view's sequence base: above anything the quorum can have
+            # committed. Jump the execution watermark there — sequences below
+            # it can never commit in this view, and every request that might
+            # have committed in one rides the certified re-proposals.
+            base = self._safe_next_seq(quorum)
+            self.next_seq = base
+            self.executed_through = max(self.executed_through, base - 1)
+            self._broadcast(NewView(vc.new_view, quorum, reqs))
             for req in reqs:
-                self._on_request(req)
+                self._propose(req)
+            for req in list(self._pending.values()):
+                if req.request_id not in {r.request_id for r in reqs}:
+                    self._propose(req)
+
+    def _propose(self, req: Request) -> None:
+        """Assign the next sequence and pre-prepare (primary only). Unlike
+        _on_request this does NOT skip locally-executed requests: a certified
+        re-proposal must reach replicas that never executed it."""
+        seq = self.next_seq
+        self.next_seq += 1
+        self._broadcast(PrePrepare(self.view, seq, _digest(req), req))
 
     def _on_new_view(self, nv: NewView) -> None:
-        if nv.view < self.view:
+        if nv.view <= self.view:
+            return
+        senders = {vc.replica for vc in nv.view_changes}
+        derived = (self._derive_requests(nv.view_changes)
+                   if (len(senders) >= 2 * self.f + 1
+                       and all(vc.new_view == nv.view
+                               for vc in nv.view_changes)) else None)
+        if derived is None or derived != nv.requests:
+            # invalid quorum or a re-proposal order that doesn't follow from
+            # it — treat the claimed leader as faulty
+            self._vote_view_change(nv.view + 1)
             return
         self.view = nv.view
         self._ticks_waiting = 0
+        self._log = {s: pp for s, pp in self._log.items()
+                     if s <= self.executed_through}
+        base = self._safe_next_seq(nv.view_changes)   # same jump as the leader
+        self.executed_through = max(self.executed_through, base - 1)
+        self._expected_order = [r.request_id for r in nv.requests]
         for req in nv.requests:
             if req.request_id not in self._executed_requests:
                 self._pending.setdefault(req.request_id, req)

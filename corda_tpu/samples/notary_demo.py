@@ -155,6 +155,92 @@ def run_raft_demo(rounds: int = 2):
             "commit_log_size": len(machines[0])}
 
 
+def run_bft_demo(rounds: int = 2):
+    """The BFT cluster variant (the reference's BFTNotaryCordform analog):
+    a 4-replica (f = 1) PBFT cluster totally orders the notary commit log;
+    the uniqueness provider submits through the BFT client and accepts on an
+    f+1 matching-reply quorum. Pump threading mirrors run_raft_demo — the
+    BFT endpoints drain on a background thread while the main thread runs
+    the node network."""
+    import threading
+    import time as _time
+
+    from ..consensus.bft import BFTClient, BFTReplica, BFTUniquenessProvider
+    from ..consensus.raft_uniqueness import DistributedImmutableMap
+    from ..node.notary import SimpleNotaryService
+    from ..node.services import ServiceInfo
+
+    network = MockNetwork()
+    notary = network.create_node(
+        "O=BFT Notary, L=Zurich, C=CH",
+        advertised_services=(ServiceInfo("corda.notary.simple"),))
+    party = network.create_node("O=Counterparty, L=Oslo, C=NO")
+    network.start_nodes()
+
+    names = [f"bft{i}" for i in range(4)]
+    machines = [DistributedImmutableMap() for _ in names]
+    replicas = [BFTReplica(n, names, network.bus.create_node(n),
+                           machines[i].apply)
+                for i, n in enumerate(names)]
+    client = BFTClient("bft-client", names,
+                       network.bus.create_node("bft-client"))
+    provider = BFTUniquenessProvider(client)
+    bft_names = set(names) | {"bft-client"}
+    stop = threading.Event()
+
+    def bft_pump():
+        while not stop.is_set():
+            for r in replicas:
+                r.tick()
+            for name in bft_names:
+                while network.bus.pump_receive(name) is not None:
+                    pass
+            _time.sleep(0.002)
+
+    pump_thread = threading.Thread(target=bft_pump, daemon=True)
+    pump_thread.start()
+
+    svc = SimpleNotaryService(notary.services, uniqueness=provider)
+    svc.install(notary.smm)
+
+    notarised = 0
+    try:
+        for i in range(rounds):
+            builder = TransactionBuilder(notary=notary.party)
+            builder.add_output_state(DummyState(i, (party.party.owning_key,)))
+            builder.add_command(DummyContract.Create(), party.party.owning_key)
+            stx = party.services.sign_initial_transaction(
+                builder.to_wire_transaction())
+            fsm = party.start_flow(FinalityFlow(stx))
+            network.run_network(exclude=bft_names)
+            issued = fsm.result_future.result(timeout=5)
+            sref = StateAndRef(issued.tx.outputs[0], StateRef(issued.id, 0))
+
+            builder = TransactionBuilder()
+            builder.add_input_state(sref)
+            builder.add_output_state(DummyState(i + 1,
+                                                (party.party.owning_key,)))
+            builder.add_command(DummyContract.Move(), party.party.owning_key)
+            move = party.services.sign_initial_transaction(
+                builder.to_wire_transaction())
+            fsm = party.start_flow(NotaryFlow(move))
+            deadline = _time.monotonic() + 30
+            while not fsm.result_future.done():
+                network.run_network(exclude=bft_names)
+                if _time.monotonic() > deadline:
+                    raise TimeoutError("bft notarisation stalled")
+                _time.sleep(0.01)
+            fsm.result_future.result(timeout=1)
+            notarised += 1
+    finally:
+        stop.set()
+        pump_thread.join(timeout=5)
+    replicas_agree = all(len(m) == len(machines[0]) for m in machines)
+    return {"notarised": notarised, "replicas_agree": replicas_agree,
+            "commit_log_size": len(machines[0]),
+            "executed_through": [r.executed_through for r in replicas]}
+
+
 def main() -> None:
     out = run_demo(rounds=3)
     print(f"simple notary: {out['notarised']} notarised, "
@@ -165,6 +251,9 @@ def main() -> None:
     out = run_raft_demo(rounds=2)
     print(f"raft notary: {out['notarised']} notarised over a 3-replica "
           f"commit log (replicas agree: {out['replicas_agree']})")
+    out = run_bft_demo(rounds=2)
+    print(f"bft notary: {out['notarised']} notarised over a 4-replica "
+          f"(f=1) PBFT cluster (replicas agree: {out['replicas_agree']})")
 
 
 if __name__ == "__main__":

@@ -76,6 +76,59 @@ def test_view_change_on_primary_failure():
     assert all(len(machines[i]) == 1 for i in range(1, 4))
 
 
+def test_view_change_carries_prepared_request():
+    """A request that PREPARED under the old primary (but never committed —
+    commits were lost) must survive the view change via the prepared
+    certificates in the ViewChange quorum and execute exactly once."""
+    from corda_tpu.core.serialization import deserialize
+    from corda_tpu.consensus.bft import CommitMsg
+
+    bus, replicas, machines, client = make_cluster()
+    primary = replicas[0]
+
+    def block_commits(t):
+        try:
+            return not isinstance(deserialize(t.message.data), CommitMsg)
+        except Exception:
+            return True
+
+    bus.transfer_filter = block_commits
+    fut = client.submit(commit_entry(b"t1", [ref(1)]))
+    pump(bus, replicas, ticks=3)     # everyone prepares, nobody commits
+    assert all(r._prepared for r in replicas)
+    assert all(r.executed_through == -1 for r in replicas)
+
+    # old primary dies; commits stay blocked for it, flow for the rest
+    bus.transfer_filter = lambda t: primary.replica_id not in (t.sender,
+                                                               t.recipient)
+    live = replicas[1:]
+    pump(bus, live, ticks=60)        # timeout → certified view change
+    assert fut.result(timeout=1)["committed"]
+    assert all(len(machines[i]) == 1 for i in range(1, 4))
+    assert all(r.view >= 1 for r in live)
+
+
+def test_forged_new_view_rejected():
+    """A NewView whose re-proposal order does not follow from its embedded
+    ViewChange quorum is rejected — the receiver votes the next view instead
+    of adopting the forged order."""
+    from corda_tpu.core.serialization import deserialize
+    from corda_tpu.consensus.bft import NewView, Request, ViewChange
+
+    bus, replicas, machines, client = make_cluster()
+    target = replicas[2]
+    vcs = tuple(ViewChange(1, r.replica_id, -1, ()) for r in replicas[:3])
+    forged = Request(999, "client", ("put_all", [SecureHash.sha256(b"evil"),
+                                                 [ref(5)], "x"]))
+    target._handle(NewView(1, vcs, (forged,)))  # quorum implies (), not this
+    assert target.view == 0           # forged view not adopted
+    assert len(machines[2]) == 0      # forged request not applied
+    # and the target pushed back with a vote for the view AFTER the forgery
+    votes = [deserialize(t.message.data) for t in bus.sent_log
+             if t.sender == target.replica_id]
+    assert any(isinstance(v, ViewChange) and v.new_view == 2 for v in votes)
+
+
 def test_bft_uniqueness_provider():
     import threading
     bus, replicas, machines, client = make_cluster()

@@ -50,6 +50,13 @@ def test_notary_demo_raft_cluster():
     assert out["commit_log_size"] == 2
 
 
+def test_notary_demo_bft_cluster():
+    out = notary_demo.run_bft_demo(rounds=2)
+    assert out["notarised"] == 2
+    assert out["replicas_agree"]
+    assert out["commit_log_size"] == 2
+
+
 def test_attachment_demo():
     out = attachment_demo.run_demo()
     assert out["attachment"].data == out["document"]
