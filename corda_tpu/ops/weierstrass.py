@@ -184,7 +184,7 @@ def shamir_ladder(bits1, bits2, P1, P2, curve: WeierstrassCurve):
         return add(acc, addend, curve), None
 
     acc, _ = jax.lax.scan(step, Pid, (bits1.astype(jnp.uint64),
-                                      bits2.astype(jnp.uint64)))
+                                      bits2.astype(jnp.uint64)), unroll=2)
     return acc
 
 
