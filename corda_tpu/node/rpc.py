@@ -72,24 +72,42 @@ class CordaRPCOps:
 
     def start_flow_dynamic(self, flow_class_or_name, *args, **kwargs):
         """startFlowDynamic: only @StartableByRPC flows may be started
-        (CordaRPCOpsImpl.startFlowDynamic)."""
-        if isinstance(flow_class_or_name, str):
-            flows = rpc_startable_flows()
-            cls = flows.get(flow_class_or_name)
-            if cls is None:
-                matches = [c for n, c in flows.items()
-                           if n.rsplit(".", 1)[-1] == flow_class_or_name]
-                if len(matches) != 1:
+        (CordaRPCOpsImpl.startFlowDynamic); every permission decision is
+        audited (FlowPermissionAuditEvent)."""
+        requested = (flow_class_or_name if isinstance(flow_class_or_name, str)
+                     else flow_name(flow_class_or_name))
+        try:
+            if isinstance(flow_class_or_name, str):
+                flows = rpc_startable_flows()
+                cls = flows.get(flow_class_or_name)
+                if cls is None:
+                    matches = [c for n, c in flows.items()
+                               if n.rsplit(".", 1)[-1] == flow_class_or_name]
+                    if len(matches) != 1:
+                        raise FlowPermissionException(
+                            f"Unknown or ambiguous flow {flow_class_or_name!r}")
+                    cls = matches[0]
+            else:
+                cls = flow_class_or_name
+                if not getattr(cls, "_startable_by_rpc", False):
                     raise FlowPermissionException(
-                        f"Unknown or ambiguous flow {flow_class_or_name!r}")
-                cls = matches[0]
-        else:
-            cls = flow_class_or_name
-            if not getattr(cls, "_startable_by_rpc", False):
-                raise FlowPermissionException(
-                    f"{flow_name(cls)} is not annotated @StartableByRPC")
+                        f"{flow_name(cls)} is not annotated @StartableByRPC")
+        except FlowPermissionException:
+            self._audit_permission(requested, granted=False)
+            raise
+        self._audit_permission(requested, granted=True)
         flow: FlowLogic = cls(*args, **kwargs)
         return self.smm.add(flow)
+
+    def _audit_permission(self, flow: str, granted: bool) -> None:
+        audit = getattr(self.hub, "audit", None)
+        if audit is not None:
+            from .audit import FlowPermissionAuditEvent
+            audit.record_audit_event(FlowPermissionAuditEvent(
+                description="startFlowDynamic permission check",
+                principal="rpc", flow_type=flow,
+                permission_requested=f"StartFlow.{flow}",
+                permission_granted=granted))
 
     def state_machines_snapshot(self) -> list[StateMachineInfo]:
         return [StateMachineInfo(fsm.run_id, flow_name(type(fsm.flow)), fsm.done)

@@ -215,6 +215,8 @@ class ServiceHub:
         # the node-wide metric registry (MonitoringService.kt:11 parity);
         # the verifier service and SMM publish into it, /metrics exports it
         self.monitoring = MetricRegistry()
+        from .audit import InMemoryAuditService
+        self.audit = InMemoryAuditService()
         self.storage = TransactionStorage()
         self.key_management = KeyManagementService(key_pairs)
         self.identity_service = InMemoryIdentityService([my_info.legal_identity])

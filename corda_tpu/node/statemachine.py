@@ -159,6 +159,12 @@ class StateMachineManager:
         if monitoring is not None:   # Flows.StartedPerSecond analog
             monitoring.meter("Flows.Started").mark()
             monitoring.counter("Flows.InFlight").inc()
+        audit = getattr(self.hub, "audit", None)
+        if audit is not None:
+            from .audit import FlowStartEvent
+            audit.record_audit_event(FlowStartEvent(
+                description="flow started",
+                flow_type=flow_name(type(fsm.flow)), flow_id=fsm.run_id))
         self.flows[fsm.run_id] = fsm
         fsm.flow.state_machine = fsm
         fsm.flow.service_hub = self.hub
@@ -486,6 +492,13 @@ class StateMachineManager:
 
     def _fail(self, fsm: FlowStateMachine, error: Exception) -> None:
         fsm.done = True
+        audit = getattr(self.hub, "audit", None)
+        if audit is not None:
+            from .audit import FlowErrorAuditEvent
+            audit.record_audit_event(FlowErrorAuditEvent(
+                description="flow failed",
+                flow_type=flow_name(type(fsm.flow)), flow_id=fsm.run_id,
+                error=f"{type(error).__name__}: {error}"))
         self._end_sessions(fsm, error=error)
         self._finalize(fsm)
         fsm.result_future.set_exception(error)

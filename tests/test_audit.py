@@ -1,0 +1,68 @@
+"""Audit service: typed event taxonomy + node recording sites.
+
+Reference analog: services/api/AuditService.kt:14-93 (event hierarchy incl.
+FlowPermissionAuditEvent) — here verified against real flow runs.
+"""
+import pytest
+
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.finance import CashIssueFlow
+from corda_tpu.node.audit import (FlowErrorAuditEvent, FlowPermissionAuditEvent,
+                                  FlowStartEvent, InMemoryAuditService,
+                                  SystemAuditEvent)
+from corda_tpu.node.rpc import CordaRPCOps, FlowPermissionException
+from corda_tpu.testing import MockNetwork
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    bank = network.create_node("O=Bank, L=London, C=GB")
+    network.start_nodes()
+    return network, notary, bank
+
+
+def test_flow_lifecycle_and_permission_events(net):
+    network, notary, bank = net
+    audit = bank.services.audit
+    rpc = CordaRPCOps(bank.services, bank.smm)
+    seen = []
+    audit.add_observer(seen.append)
+
+    rpc.start_flow_dynamic("CashIssueFlow", Amount(5000, USD), b"\x01",
+                           bank.party, notary.party)
+    network.run_network()
+    starts = audit.events(FlowStartEvent)
+    assert any(e.flow_type.endswith("CashIssueFlow") for e in starts)
+    perms = audit.events(FlowPermissionAuditEvent)
+    assert perms and perms[0].permission_granted
+    assert perms[0].permission_requested.startswith("StartFlow.")
+    assert seen  # observer callback fired
+
+    with pytest.raises(FlowPermissionException):
+        rpc.start_flow_dynamic("NotAFlow")
+    denied = [e for e in audit.events(FlowPermissionAuditEvent)
+              if not e.permission_granted]
+    assert denied and denied[0].flow_type == "NotAFlow"
+
+
+def test_flow_error_event(net):
+    network, notary, bank = net
+    # a flow that fails: pay more cash than the vault holds
+    from corda_tpu.finance import CashPaymentFlow
+    fsm = bank.start_flow(CashPaymentFlow(Amount(10**9, USD), notary.party))
+    network.run_network()
+    with pytest.raises(Exception):
+        fsm.result_future.result(timeout=1)
+    errors = bank.services.audit.events(FlowErrorAuditEvent)
+    assert errors and "Insufficient" in errors[-1].error
+
+
+def test_capacity_bound():
+    svc = InMemoryAuditService(capacity=5)
+    for i in range(12):
+        svc.record_audit_event(SystemAuditEvent(description=f"e{i}"))
+    evs = svc.events()
+    assert len(evs) == 5
+    assert evs[-1].description == "e11"
