@@ -102,48 +102,51 @@ class DriverDSL:
             os.path.dirname(os.path.abspath(__file__)))))
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.STDOUT, text=True, env=env)
-        # _await_ready's reader thread keeps draining stdout for the process
-        # lifetime, so the node never blocks on a full pipe
-        host, port = self._await_ready(proc, name)
+        # await_node_ready's reader thread keeps draining stdout for the
+        # process lifetime, so the node never blocks on a full pipe
+        host, port = await_node_ready(proc, name, self.startup_timeout_s)
         rpc = CordaRPCClient(host, port)
         handle = NodeHandle(name, host, port, proc, rpc)
         self.nodes.append(handle)
         return handle
 
-    def _await_ready(self, proc: subprocess.Popen, name: str):
-        """Block until the node prints its NODE READY line (driver futures).
-        Lines are read on a helper thread so a silently-hung child still
-        trips the timeout instead of blocking readline forever."""
-        import queue as _queue
-        import threading
-        lines_q: "_queue.Queue" = _queue.Queue()
 
-        def _reader():
-            for line in proc.stdout:
-                lines_q.put(line)
-            lines_q.put(None)  # EOF
+def await_node_ready(proc: subprocess.Popen, name: str,
+                     timeout_s: float = 60.0):
+    """Block until a node subprocess prints its NODE READY line (driver
+    futures); returns (host, port). Lines are read on a helper thread so a
+    silently-hung child still trips the timeout instead of blocking readline
+    forever. Shared by the driver DSL and the demobench launcher."""
+    import queue as _queue
+    import threading
+    lines_q: "_queue.Queue" = _queue.Queue()
 
-        threading.Thread(target=_reader, daemon=True).start()
-        deadline = time.monotonic() + self.startup_timeout_s
-        lines = []
-        while True:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                proc.kill()
-                raise TimeoutError(
-                    f"node {name} did not start in time:\n" + "".join(lines))
-            try:
-                line = lines_q.get(timeout=min(remaining, 1.0))
-            except _queue.Empty:
-                continue
-            if line is None:
-                raise RuntimeError(
-                    f"node {name} exited during startup:\n" + "".join(lines))
-            lines.append(line)
-            if line.startswith("NODE READY"):
-                addr = line.strip().rsplit(" ", 1)[-1]
-                host, _, port = addr.rpartition(":")
-                return host, int(port)
+    def _reader():
+        for line in proc.stdout:
+            lines_q.put(line)
+        lines_q.put(None)  # EOF
+
+    threading.Thread(target=_reader, daemon=True).start()
+    deadline = time.monotonic() + timeout_s
+    lines = []
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            proc.kill()
+            raise TimeoutError(
+                f"node {name} did not start in time:\n" + "".join(lines))
+        try:
+            line = lines_q.get(timeout=min(remaining, 1.0))
+        except _queue.Empty:
+            continue
+        if line is None:
+            raise RuntimeError(
+                f"node {name} exited during startup:\n" + "".join(lines))
+        lines.append(line)
+        if line.startswith("NODE READY"):
+            addr = line.strip().rsplit(" ", 1)[-1]
+            host, _, port = addr.rpartition(":")
+            return host, int(port)
 
 
 def driver(base_dir: str, **kwargs) -> DriverDSL:

@@ -1,0 +1,59 @@
+"""DemoBench / cordform network-spec tests.
+
+Reference analogs: cordformation's deployNodes config generation and
+DemoBench's launch/stop lifecycle (tools/demobench) — config expansion is
+unit-tested; the full launch is a slow integration test over real node
+processes like the driver tier.
+"""
+import json
+import urllib.request
+
+import pytest
+
+from corda_tpu.node.node import NodeConfiguration
+from corda_tpu.tools.demobench import (DemoBench, MAP_NAME,
+                                       generate_node_configs)
+
+
+def spec_for(tmp_path, **extra):
+    return {
+        "base_directory": str(tmp_path / "net"),
+        "nodes": [
+            {"name": "O=Notary, L=Zurich, C=CH", "notary": "simple"},
+            {"name": "O=Alice, L=London, C=GB", **extra},
+        ],
+    }
+
+
+def test_generate_node_configs(tmp_path):
+    spec = spec_for(tmp_path)
+    spec["map_port"] = 10123
+    paths = generate_node_configs(spec)
+    assert len(paths) == 3                     # implicit map node first
+    cfgs = [NodeConfiguration.load(p) for p in paths]
+    assert cfgs[0].my_legal_name == MAP_NAME
+    assert cfgs[0].port == 10123
+    assert cfgs[1].notary == "simple"
+    assert cfgs[2].network_map_address == "127.0.0.1:10123"
+    assert cfgs[2].network_map_name == MAP_NAME
+    # regenerating is idempotent (same paths, loadable configs)
+    assert generate_node_configs(spec) == paths
+
+
+@pytest.mark.slow
+def test_demobench_launch_and_rest(tmp_path):
+    spec = spec_for(tmp_path, web_port=0)
+    bench = DemoBench(spec).launch()
+    try:
+        rows = bench.status()
+        assert len(rows) == 3 and all(r["alive"] for r in rows)
+        web = next(r["web"] for r in rows if "Alice" in r["name"])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{web}/api/status", timeout=10) as r:
+            status = json.loads(r.read())
+        assert "Alice" in status["identity"]["legal_identity"]["name"]
+        assert bench.stop_node("Alice")
+        assert any(not r["alive"] for r in bench.status())
+    finally:
+        bench.shutdown()
+    assert all(not r.alive for r in bench.nodes) or bench.nodes == []
