@@ -1,0 +1,198 @@
+"""Deterministic sandbox for contract verification code.
+
+Reference parity: experimental/sandbox — the prototype deterministic JVM
+sandbox for contract code (WhitelistClassLoader.java:1-356: whitelist class
+loading + ASM bytecode rewriting; visitors/CostInstrumentingMethodVisitor +
+costing/RuntimeCostAccounter: runtime cost accounting that kills runaway
+code). The TPU build's contract bodies are Python, so the same two defenses
+become:
+
+- **Whitelist validation** (the WhitelistClassLoader role): contract source
+  is parsed to an AST and rejected unless every construct is on the
+  whitelist — no imports outside the allowed set, no dunder/underscore
+  attribute access, no global/nonlocal, no async, no set displays (string
+  hashing is process-seeded, so set iteration order is nondeterministic),
+  and execution sees only a curated builtins table (no eval/exec/open/
+  getattr/globals/hash/id/print...).
+- **Cost accounting** (the CostInstrumentingMethodVisitor role): the AST is
+  rewritten before compilation so every statement charges the instruction
+  budget and every loop/comprehension iterates through a charging iterator;
+  exhausting the budget raises SandboxCostExceeded mid-flight, exactly like
+  the reference's TerminateException on runtime-cost thresholds.
+
+Determinism, not security isolation, is the goal (same stance as the
+reference prototype): the sandbox guarantees that a contract either
+produces the same verdict on every node or dies the same way on every node.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+
+class SandboxViolation(Exception):
+    """Contract source uses a construct outside the deterministic whitelist."""
+
+
+class SandboxCostExceeded(Exception):
+    """Contract execution exhausted its instruction budget."""
+
+
+_SAFE_BUILTIN_NAMES = (
+    "abs", "all", "any", "bool", "bytes", "chr", "dict", "divmod",
+    "enumerate", "filter", "float", "format", "int", "isinstance",
+    "issubclass", "iter", "len", "list", "map", "max", "min", "next", "ord",
+    "pow", "range", "repr", "reversed", "round", "slice", "sorted", "str",
+    "sum", "tuple", "zip",
+    # exceptions contract code may raise/catch
+    "Exception", "ValueError", "TypeError", "ArithmeticError",
+    "AssertionError", "ZeroDivisionError", "StopIteration", "IndexError",
+    "KeyError",
+)
+
+_BANNED_NODES = {
+    ast.Import: "import",
+    ast.ImportFrom: "import",
+    ast.Global: "global",
+    ast.Nonlocal: "nonlocal",
+    ast.AsyncFunctionDef: "async def",
+    ast.AsyncFor: "async for",
+    ast.AsyncWith: "async with",
+    ast.Await: "await",
+    ast.Set: "set display (hash-order nondeterminism)",
+    ast.SetComp: "set comprehension (hash-order nondeterminism)",
+    ast.With: "with",
+}
+
+
+def validate(source: str) -> ast.Module:
+    """Parse + whitelist-check contract source; returns the AST."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        raise SandboxViolation(f"syntax error: {e}") from e
+    for node in ast.walk(tree):
+        for banned, label in _BANNED_NODES.items():
+            if isinstance(node, banned):
+                raise SandboxViolation(
+                    f"line {getattr(node, 'lineno', '?')}: {label} "
+                    f"is not allowed in sandboxed contract code")
+        if isinstance(node, ast.Attribute) and node.attr.startswith("_"):
+            raise SandboxViolation(
+                f"line {node.lineno}: access to underscore attribute "
+                f"{node.attr!r} is not allowed")
+        if isinstance(node, ast.Name) and node.id.startswith("__"):
+            raise SandboxViolation(
+                f"line {node.lineno}: dunder name {node.id!r} is not allowed")
+    return tree
+
+
+class _CostTransformer(ast.NodeTransformer):
+    """Rewrite so execution charges the budget: a __charge__() call before
+    every statement, and every for/comprehension iterable wrapped in the
+    charging iterator (per-iteration accounting, the per-instruction
+    accounting analog)."""
+
+    CHARGE = "_sandbox_charge"
+    ITER = "_sandbox_iter"
+
+    def _charge_stmt(self, at) -> ast.Expr:
+        return ast.copy_location(ast.Expr(ast.Call(
+            ast.Name(self.CHARGE, ast.Load()), [], [])), at)
+
+    def _rewrite_body(self, body: list) -> list:
+        out = []
+        for stmt in body:
+            stmt = self.visit(stmt)
+            out.append(self._charge_stmt(stmt))
+            out.append(stmt)
+        return out
+
+    def visit_Module(self, node):
+        node.body = self._rewrite_body(node.body)
+        return node
+
+    def visit_FunctionDef(self, node):
+        node.body = self._rewrite_body(node.body)
+        return node
+
+    def visit_For(self, node):
+        node.iter = ast.copy_location(ast.Call(
+            ast.Name(self.ITER, ast.Load()), [self.visit(node.iter)], []),
+            node.iter)
+        node.body = self._rewrite_body(node.body)
+        node.orelse = self._rewrite_body(node.orelse)
+        return node
+
+    def visit_While(self, node):
+        node.test = self.visit(node.test)
+        node.body = self._rewrite_body(node.body)
+        node.orelse = self._rewrite_body(node.orelse)
+        return node
+
+    def _wrap_comp(self, node):
+        node = self.generic_visit(node)
+        for gen in node.generators:
+            gen.iter = ast.copy_location(ast.Call(
+                ast.Name(self.ITER, ast.Load()), [gen.iter], []), gen.iter)
+        return node
+
+    visit_ListComp = _wrap_comp
+    visit_DictComp = _wrap_comp
+    visit_GeneratorExp = _wrap_comp
+
+
+@dataclass
+class DeterministicSandbox:
+    """Load + run contract code under the whitelist and an instruction budget
+    (RuntimeCostAccounter role; budget = charged statements + iterations)."""
+
+    instruction_budget: int = 1_000_000
+
+    def load(self, source: str, bindings: dict | None = None) -> dict:
+        """Validate, instrument, and execute a contract module's top level.
+        Returns its namespace; classes/functions defined there keep charging
+        against this sandbox's budget when called later. ``bindings`` are
+        extra names made visible (the framework types the contract needs —
+        the whitelisted-classes analog)."""
+        tree = validate(source)
+        tree = _CostTransformer().visit(tree)
+        ast.fix_missing_locations(tree)
+        code = compile(tree, "<sandboxed-contract>", "exec")
+        self._spent = 0
+
+        def charge():
+            self._spent += 1
+            if self._spent > self.instruction_budget:
+                raise SandboxCostExceeded(
+                    f"instruction budget {self.instruction_budget} exhausted")
+
+        def charged_iter(it):
+            for item in iter(it):
+                charge()
+                yield item
+
+        def _builtin(name):
+            return (__builtins__[name] if isinstance(__builtins__, dict)
+                    else getattr(__builtins__, name))
+
+        safe_builtins = {name: _builtin(name) for name in _SAFE_BUILTIN_NAMES}
+        # class-statement machinery (builds only already-validated code)
+        safe_builtins["__build_class__"] = _builtin("__build_class__")
+        namespace = {
+            "__builtins__": safe_builtins,
+            "__name__": "sandboxed_contract",
+            _CostTransformer.CHARGE: charge,
+            _CostTransformer.ITER: charged_iter,
+        }
+        namespace.update(bindings or {})
+        exec(code, namespace)
+        return namespace
+
+    @property
+    def spent(self) -> int:
+        return getattr(self, "_spent", 0)
+
+    def run(self, fn, *args, **kwargs):
+        """Call a function loaded by this sandbox (charging continues)."""
+        return fn(*args, **kwargs)

@@ -1,0 +1,86 @@
+"""Deterministic contract sandbox tests.
+
+Reference analogs: experimental/sandbox's WhitelistClassLoaderTest (accept
+whitelisted code, reject banned constructs) and the runtime cost-accounting
+thresholds (runaway code terminates deterministically).
+"""
+import pytest
+
+from corda_tpu.core.contracts.sandbox import (DeterministicSandbox,
+                                              SandboxCostExceeded,
+                                              SandboxViolation, validate)
+
+CONTRACT = """
+class TokenContract:
+    def verify(self, inputs, outputs):
+        total_in = sum(v for v in inputs)
+        total_out = sum(v for v in outputs)
+        if total_in != total_out:
+            raise ValueError("conservation violated")
+        return "ok"
+"""
+
+
+def test_loads_and_runs_whitelisted_contract():
+    sandbox = DeterministicSandbox()
+    ns = sandbox.load(CONTRACT)
+    contract = ns["TokenContract"]()
+    assert sandbox.run(contract.verify, [5, 7], [12]) == "ok"
+    with pytest.raises(ValueError, match="conservation"):
+        sandbox.run(contract.verify, [5], [12])
+    assert sandbox.spent > 0
+
+
+@pytest.mark.parametrize("source,label", [
+    ("import os", "import"),
+    ("from os import path", "import"),
+    ("x = {1, 2}", "set display"),
+    ("x = {v for v in range(3)}", "set comprehension"),
+    ("def f():\n    global x", "global"),
+    ("async def f():\n    pass", "async"),
+    ("x = obj._secret", "underscore attribute"),
+    ("x = __import__", "dunder name"),
+    ("with open('f') as f:\n    pass", "with"),
+])
+def test_banned_constructs_rejected(source, label):
+    with pytest.raises(SandboxViolation):
+        validate(source)
+
+
+def test_unsafe_builtins_absent():
+    sandbox = DeterministicSandbox()
+    for expr in ("eval('1')", "exec('x=1')", "open('/etc/hostname')",
+                 "getattr(int, 'bit_length')", "globals()", "hash('a')",
+                 "id(1)", "print('hi')"):
+        with pytest.raises((NameError, KeyError)):
+            sandbox.load(f"result = {expr}")
+
+
+def test_runaway_loop_hits_budget():
+    sandbox = DeterministicSandbox(instruction_budget=1000)
+    with pytest.raises(SandboxCostExceeded):
+        sandbox.load("while True:\n    x = 1\n")
+
+
+def test_iteration_is_charged():
+    src = "total = sum(i for i in range(10_000))"
+    with pytest.raises(SandboxCostExceeded):
+        DeterministicSandbox(instruction_budget=100).load(src)
+    ns = DeterministicSandbox(instruction_budget=100_000).load(src)
+    assert ns["total"] == sum(range(10_000))
+
+
+def test_budget_spans_later_calls():
+    """Functions defined in the sandbox keep charging when called after
+    load — the budget covers the contract's whole lifetime."""
+    sandbox = DeterministicSandbox(instruction_budget=5_000)
+    ns = sandbox.load("def burn(n):\n    for i in range(n):\n        x = i\n")
+    ns["burn"](100)
+    with pytest.raises(SandboxCostExceeded):
+        ns["burn"](100_000)
+
+
+def test_bindings_visible():
+    sandbox = DeterministicSandbox()
+    ns = sandbox.load("answer = helper(20)", bindings={"helper": lambda v: v * 2 + 2})
+    assert ns["answer"] == 42
