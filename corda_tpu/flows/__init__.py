@@ -33,3 +33,7 @@ from .api import (  # noqa: F401
     get_initiated_flow_factory,
     rpc_startable_flows,
 )
+from .confidential import (  # noqa: F401
+    TransactionKeyFlow,
+    TransactionKeyHandler,
+)

@@ -39,10 +39,13 @@ class InMemoryAttachmentStorage:
 
 
 class InMemoryIdentityService:
-    """key → Party resolution (InMemoryIdentityService.kt:1-162)."""
+    """key → Party resolution, including verified anonymous identities
+    (InMemoryIdentityService.kt:1-162: registerAnonymousIdentity with
+    ownership proof, partyFromAnonymous)."""
 
     def __init__(self, parties=()):
         self._by_key: dict[PublicKey, Party] = {}
+        self._anonymous: dict[PublicKey, Party] = {}
         for p in parties:
             self.register(p)
 
@@ -50,11 +53,37 @@ class InMemoryIdentityService:
         self._by_key[party.owning_key] = party
 
     def party_from_key(self, key: PublicKey) -> Party | None:
-        return self._by_key.get(key)
+        return self._by_key.get(key) or self._anonymous.get(key)
 
     def parties_from_keys(self, keys) -> tuple[Party, ...]:
-        return tuple(p for p in (self._by_key.get(k) for k in keys)
+        return tuple(p for p in (self.party_from_key(k) for k in keys)
                      if p is not None)
+
+    # -- confidential identities --------------------------------------------
+    @staticmethod
+    def ownership_content(anonymous_key: PublicKey, owner_name) -> bytes:
+        """The canonical bytes a well-known identity signs to attest it owns
+        an anonymous key (the certificate-path role of the reference's
+        registerAnonymousIdentity, X.509 replaced by the canonical codec)."""
+        from ..core.serialization import serialize
+        return serialize(["confidential-identity", anonymous_key,
+                          str(owner_name)])
+
+    def verify_and_register_anonymous(self, anonymous, well_known: Party,
+                                      signature: bytes) -> None:
+        """Validate the ownership attestation and record the mapping;
+        raises on a bad signature (registerAnonymousIdentity semantics)."""
+        from ..core.crypto.signatures import DigitalSignatureWithKey
+        content = self.ownership_content(anonymous.owning_key, well_known.name)
+        DigitalSignatureWithKey(signature, well_known.owning_key).verify(content)
+        self._anonymous[anonymous.owning_key] = well_known
+
+    def well_known_party_from_anonymous(self, party) -> Party | None:
+        """partyFromAnonymous: resolve an AnonymousParty (or pass a Party
+        through) to its verified well-known identity."""
+        if isinstance(party, Party):
+            return party
+        return self._anonymous.get(party.owning_key)
 
 
 @dataclass(frozen=True)

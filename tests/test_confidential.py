@@ -1,0 +1,56 @@
+"""Confidential identities: TransactionKeyFlow exchange tests.
+
+Reference analog: TransactionKeyFlow + IdentityService registerAnonymous
+(anonymous keys swap with ownership attestations; forged attestations are
+refused)."""
+import pytest
+
+from corda_tpu.core.identity import AnonymousParty
+from corda_tpu.flows import FlowException, TransactionKeyFlow
+from corda_tpu.testing import MockNetwork
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    a = network.create_node("O=Alice, L=London, C=GB")
+    b = network.create_node("O=Bob, L=Paris, C=FR")
+    network.start_nodes()
+    return network, a, b
+
+
+def test_transaction_key_exchange(net):
+    network, a, b = net
+    fsm = a.start_flow(TransactionKeyFlow(b.party))
+    network.run_network()
+    identities = fsm.result_future.result(timeout=1)
+
+    anon_a = identities[a.party]
+    anon_b = identities[b.party]
+    assert isinstance(anon_a, AnonymousParty) and isinstance(anon_b,
+                                                             AnonymousParty)
+    # fresh one-time keys, not the well-known ones
+    assert anon_a.owning_key != a.party.owning_key
+    assert anon_b.owning_key != b.party.owning_key
+    # each side can resolve the PEER's anonymous identity to the well-known
+    assert (a.services.identity_service.well_known_party_from_anonymous(anon_b)
+            == b.party)
+    assert (b.services.identity_service.well_known_party_from_anonymous(anon_a)
+            == a.party)
+    # and can sign with its own fresh key (it is in the KMS)
+    assert a.services.key_management.sign(b"x", anon_a.owning_key)
+
+
+def test_forged_attestation_refused(net):
+    network, a, b = net
+    # Alice claims an anonymous key with a signature from the WRONG identity
+    fresh = a.services.key_management.fresh_key()
+    anon = AnonymousParty(fresh.public)
+    content = a.services.identity_service.ownership_content(
+        fresh.public, b.party.name)
+    forged = a.services.sign(content).bytes   # signed by Alice, claims Bob
+    with pytest.raises(Exception):
+        b.services.identity_service.verify_and_register_anonymous(
+            anon, b.party, forged)
+    assert (b.services.identity_service.well_known_party_from_anonymous(anon)
+            is None)
