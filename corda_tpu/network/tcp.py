@@ -199,14 +199,17 @@ class TcpMessagingService(MessagingService):
         self._handlers.remove(reg)
 
     def stop(self) -> None:
-        def _shutdown():
-            for task in self._sender_tasks.values():
+        async def _shutdown():
+            tasks = list(self._sender_tasks.values())
+            for task in tasks:
                 task.cancel()
+            # await the cancellations so the loop retires them cleanly
+            await asyncio.gather(*tasks, return_exceptions=True)
             for w in self._writers.values():
                 w.close()
             if self._server is not None:
                 self._server.close()
             self._loop.stop()
 
-        self._loop.call_soon_threadsafe(_shutdown)
+        asyncio.run_coroutine_threadsafe(_shutdown(), self._loop)
         self._thread.join(timeout=5)

@@ -1,0 +1,416 @@
+// raftcore — the Raft protocol state machine as a native library.
+//
+// Reference parity: the role Copycat's core plays for the replicated notary
+// commit log (RaftUniquenessProvider.kt:41,101-155). SURVEY.md §2's native
+// plan calls for a C++ Raft; this is it: elections, log replication, the
+// commit rule, and in-order apply are decided HERE, behind a C ABI. The
+// Python layer (corda_tpu/consensus/raftcore.py) does transport and state-
+// machine application, draining a typed action queue after every call.
+//
+// Log entries are opaque byte blobs (the canonical-codec bytes of the
+// client triple), so the core is wire-compatible with the pure-Python
+// RaftNode: mixed clusters replicate the same messages.
+//
+// Concurrency contract: calls are NOT thread-safe; the Python wrapper holds
+// one lock around every entry point (matching RaftNode's coarse lock).
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <vector>
+
+namespace {
+
+enum Role { FOLLOWER = 0, CANDIDATE = 1, LEADER = 2 };
+
+enum ActionKind {
+  ACT_NONE = 0,
+  ACT_SEND_REQUEST_VOTE = 1,   // peer, a=term, b=last_idx, c=last_term
+  ACT_SEND_VOTE_RESPONSE = 2,  // peer, a=term, flag=granted
+  ACT_SEND_APPEND = 3,         // peer, a=term, b=prev_idx, c=prev_term,
+                               // flag=leader_commit(lo32? no) -> c2 via data2
+  ACT_SEND_APPEND_RESPONSE = 4,// peer, a=term, flag=success, b=match_index
+  ACT_APPLY = 5,               // a=log index, data=blob
+  ACT_BECAME_LEADER = 6,       // a=term
+};
+
+struct Entry {
+  int64_t term;
+  std::string blob;
+};
+
+struct Action {
+  int32_t kind = ACT_NONE;
+  int32_t peer = -1;
+  int32_t flag = 0;
+  int64_t a = 0, b = 0, c = 0, d = 0;
+  std::string data;  // packed entries for APPEND, blob for APPLY
+};
+
+// Packed entry buffer: [u32 count] then per entry [i64 term][u32 len][bytes],
+// all little-endian. Shared with the Python wrapper.
+static std::string pack_entries(const std::vector<Entry>& log, size_t from) {
+  std::string out;
+  uint32_t count = static_cast<uint32_t>(log.size() - from);
+  out.append(reinterpret_cast<const char*>(&count), 4);
+  for (size_t i = from; i < log.size(); i++) {
+    int64_t t = log[i].term;
+    uint32_t len = static_cast<uint32_t>(log[i].blob.size());
+    out.append(reinterpret_cast<const char*>(&t), 8);
+    out.append(reinterpret_cast<const char*>(&len), 4);
+    out.append(log[i].blob);
+  }
+  return out;
+}
+
+static bool unpack_entries(const uint8_t* buf, uint32_t len,
+                           std::vector<Entry>* out) {
+  if (len < 4) return false;
+  uint32_t count;
+  std::memcpy(&count, buf, 4);
+  size_t off = 4;
+  for (uint32_t i = 0; i < count; i++) {
+    if (off + 12 > len) return false;
+    Entry e;
+    std::memcpy(&e.term, buf + off, 8);
+    uint32_t blen;
+    std::memcpy(&blen, buf + off + 8, 4);
+    off += 12;
+    if (off + blen > len) return false;
+    e.blob.assign(reinterpret_cast<const char*>(buf + off), blen);
+    off += blen;
+    out->push_back(std::move(e));
+  }
+  return off == len;
+}
+
+struct Core {
+  // configuration
+  int32_t self;
+  int32_t n;
+  int32_t elec_min, elec_max, heartbeat;
+  uint64_t rng;
+
+  // persistent-equivalent state
+  int64_t current_term = 0;
+  int32_t voted_for = -1;
+  std::vector<Entry> log;  // 1-based indexing via helpers
+
+  // volatile state
+  int32_t role = FOLLOWER;
+  int32_t leader = -1;
+  int64_t commit_index = 0;
+  int64_t last_applied = 0;
+  int64_t ticks = 0;
+  int64_t election_deadline = 0;
+  uint32_t votes = 0;  // bitmask of granted voters (n <= 32 replicas)
+  std::vector<int64_t> next_index;
+  std::vector<int64_t> match_index;
+
+  std::deque<Action> outbox;
+  Action current;  // storage for the action handed to the caller
+
+  int64_t last_index() const { return static_cast<int64_t>(log.size()); }
+  int64_t term_at(int64_t idx) const {
+    return idx == 0 ? 0 : log[static_cast<size_t>(idx) - 1].term;
+  }
+
+  int64_t rand_range(int64_t lo, int64_t hi) {
+    // xorshift64* — deterministic per seed, good enough for timeouts
+    rng ^= rng >> 12; rng ^= rng << 25; rng ^= rng >> 27;
+    uint64_t r = rng * 2685821657736338717ULL;
+    return lo + static_cast<int64_t>(r % static_cast<uint64_t>(hi - lo + 1));
+  }
+
+  void reset_election_deadline() {
+    election_deadline = rand_range(elec_min, elec_max);
+  }
+
+  void emit(Action a) { outbox.push_back(std::move(a)); }
+
+  void observe_term(int64_t term) {
+    if (term > current_term) {
+      current_term = term;
+      voted_for = -1;
+      role = FOLLOWER;
+      leader = -1;
+    }
+  }
+
+  void send_append(int32_t peer) {
+    int64_t next_i = next_index[peer];
+    int64_t prev = next_i - 1;
+    Action a;
+    a.kind = ACT_SEND_APPEND;
+    a.peer = peer;
+    a.a = current_term;
+    a.b = prev;
+    a.c = term_at(prev);
+    a.d = commit_index;
+    a.data = pack_entries(log, static_cast<size_t>(prev));
+    emit(std::move(a));
+  }
+
+  void broadcast_append() {
+    for (int32_t p = 0; p < n; p++)
+      if (p != self) send_append(p);
+  }
+
+  void start_election() {
+    current_term += 1;
+    role = CANDIDATE;
+    voted_for = self;
+    votes = 1u << self;
+    reset_election_deadline();
+    for (int32_t p = 0; p < n; p++) {
+      if (p == self) continue;
+      Action a;
+      a.kind = ACT_SEND_REQUEST_VOTE;
+      a.peer = p;
+      a.a = current_term;
+      a.b = last_index();
+      a.c = term_at(last_index());
+      emit(std::move(a));
+    }
+    maybe_win();
+  }
+
+  void maybe_win() {
+    if (role != CANDIDATE) return;
+    if (__builtin_popcount(votes) <= n / 2) return;
+    role = LEADER;
+    leader = self;
+    next_index.assign(n, last_index() + 1);
+    match_index.assign(n, 0);
+    // current-term no-op (empty blob) lets the commit rule advance over
+    // entries replicated in previous terms (Raft §5.4.2 liveness)
+    log.push_back(Entry{current_term, std::string()});
+    Action a;
+    a.kind = ACT_BECAME_LEADER;
+    a.a = current_term;
+    emit(std::move(a));
+    broadcast_append();
+    maybe_commit();
+  }
+
+  void maybe_commit() {
+    for (int64_t idx = last_index(); idx > commit_index; idx--) {
+      if (term_at(idx) != current_term) break;  // §5.4.2 current-term rule
+      int replicated = 1;
+      for (int32_t p = 0; p < n; p++)
+        if (p != self && match_index[p] >= idx) replicated++;
+      if (replicated > n / 2) {
+        commit_index = idx;
+        break;
+      }
+    }
+    apply_committed();
+  }
+
+  void apply_committed() {
+    while (last_applied < commit_index) {
+      last_applied += 1;
+      const Entry& e = log[static_cast<size_t>(last_applied) - 1];
+      if (e.blob.empty()) continue;  // leadership no-op
+      Action a;
+      a.kind = ACT_APPLY;
+      a.a = last_applied;
+      a.data = e.blob;
+      emit(std::move(a));
+    }
+  }
+
+  // -- entry points --------------------------------------------------------
+  void tick() {
+    ticks += 1;
+    if (role == LEADER) {
+      if (ticks % heartbeat == 0) broadcast_append();
+      return;
+    }
+    election_deadline -= 1;
+    if (election_deadline <= 0) start_election();
+  }
+
+  void submit(const uint8_t* blob, uint32_t len) {
+    // leader-only (the wrapper checks role and forwards otherwise)
+    if (role != LEADER) return;
+    log.push_back(Entry{current_term,
+                        std::string(reinterpret_cast<const char*>(blob), len)});
+    broadcast_append();
+    maybe_commit();  // single-node cluster commits immediately
+  }
+
+  void on_request_vote(int64_t term, int32_t candidate, int64_t last_idx,
+                       int64_t last_term) {
+    observe_term(term);
+    bool up_to_date =
+        last_term > term_at(last_index()) ||
+        (last_term == term_at(last_index()) && last_idx >= last_index());
+    bool grant = term == current_term && up_to_date &&
+                 (voted_for == -1 || voted_for == candidate);
+    if (grant) {
+      voted_for = candidate;
+      reset_election_deadline();
+    }
+    Action a;
+    a.kind = ACT_SEND_VOTE_RESPONSE;
+    a.peer = candidate;
+    a.a = current_term;
+    a.flag = grant ? 1 : 0;
+    emit(std::move(a));
+  }
+
+  void on_vote_response(int64_t term, int32_t voter, int32_t granted) {
+    observe_term(term);
+    if (role == CANDIDATE && term == current_term && granted) {
+      votes |= 1u << voter;
+      maybe_win();
+    }
+  }
+
+  void on_append(int64_t term, int32_t from_leader, int64_t prev_idx,
+                 int64_t prev_term, const uint8_t* packed, uint32_t packed_len,
+                 int64_t leader_commit) {
+    observe_term(term);
+    if (term < current_term) {
+      Action a;
+      a.kind = ACT_SEND_APPEND_RESPONSE;
+      a.peer = from_leader;
+      a.a = current_term;
+      a.flag = 0;
+      emit(std::move(a));
+      return;
+    }
+    role = FOLLOWER;
+    leader = from_leader;
+    reset_election_deadline();
+    bool fail = prev_idx > last_index() || term_at(prev_idx) != prev_term;
+    std::vector<Entry> entries;
+    if (!fail) fail = !unpack_entries(packed, packed_len, &entries);
+    if (fail) {
+      Action a;
+      a.kind = ACT_SEND_APPEND_RESPONSE;
+      a.peer = from_leader;
+      a.a = current_term;
+      a.flag = 0;
+      emit(std::move(a));
+      return;
+    }
+    log.resize(static_cast<size_t>(prev_idx));
+    for (auto& e : entries) log.push_back(std::move(e));
+    if (leader_commit > commit_index) {
+      commit_index = std::min(leader_commit, last_index());
+    }
+    apply_committed();
+    Action a;
+    a.kind = ACT_SEND_APPEND_RESPONSE;
+    a.peer = from_leader;
+    a.a = current_term;
+    a.flag = 1;
+    a.b = last_index();
+    emit(std::move(a));
+  }
+
+  void on_append_response(int64_t term, int32_t follower, int32_t success,
+                          int64_t match) {
+    observe_term(term);
+    if (role != LEADER || term != current_term) return;
+    if (success) {
+      match_index[follower] = match;
+      next_index[follower] = match + 1;
+      maybe_commit();
+    } else {
+      next_index[follower] = std::max<int64_t>(1, next_index[follower] - 1);
+      send_append(follower);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+struct RaftActionView {
+  int32_t kind;
+  int32_t peer;
+  int32_t flag;
+  int64_t a, b, c, d;
+  const uint8_t* data;
+  uint32_t data_len;
+};
+
+void* raft_create(int32_t self, int32_t n, int32_t elec_min, int32_t elec_max,
+                  int32_t heartbeat, uint64_t seed) {
+  if (n <= 0 || n > 32 || self < 0 || self >= n) return nullptr;
+  Core* c = new Core();
+  c->self = self;
+  c->n = n;
+  c->elec_min = elec_min;
+  c->elec_max = elec_max;
+  c->heartbeat = heartbeat;
+  c->rng = seed ? seed : 0x9E3779B97F4A7C15ULL + static_cast<uint64_t>(self);
+  c->next_index.assign(n, 1);
+  c->match_index.assign(n, 0);
+  c->reset_election_deadline();
+  return c;
+}
+
+void raft_destroy(void* h) { delete static_cast<Core*>(h); }
+void raft_tick(void* h) { static_cast<Core*>(h)->tick(); }
+
+void raft_submit(void* h, const uint8_t* blob, uint32_t len) {
+  static_cast<Core*>(h)->submit(blob, len);
+}
+
+void raft_request_vote(void* h, int64_t term, int32_t candidate,
+                       int64_t last_idx, int64_t last_term) {
+  static_cast<Core*>(h)->on_request_vote(term, candidate, last_idx, last_term);
+}
+
+void raft_vote_response(void* h, int64_t term, int32_t voter,
+                        int32_t granted) {
+  static_cast<Core*>(h)->on_vote_response(term, voter, granted);
+}
+
+void raft_append_entries(void* h, int64_t term, int32_t leader,
+                         int64_t prev_idx, int64_t prev_term,
+                         const uint8_t* packed, uint32_t packed_len,
+                         int64_t leader_commit) {
+  static_cast<Core*>(h)->on_append(term, leader, prev_idx, prev_term, packed,
+                                   packed_len, leader_commit);
+}
+
+void raft_append_response(void* h, int64_t term, int32_t follower,
+                          int32_t success, int64_t match) {
+  static_cast<Core*>(h)->on_append_response(term, follower, success, match);
+}
+
+int32_t raft_role(void* h) { return static_cast<Core*>(h)->role; }
+int32_t raft_leader(void* h) { return static_cast<Core*>(h)->leader; }
+int64_t raft_term(void* h) { return static_cast<Core*>(h)->current_term; }
+int64_t raft_commit_index(void* h) {
+  return static_cast<Core*>(h)->commit_index;
+}
+int64_t raft_last_index(void* h) { return static_cast<Core*>(h)->last_index(); }
+
+// Drain one action; returns 0 when the outbox is empty. The view's data
+// pointer stays valid until the NEXT raft_* call on this handle.
+int32_t raft_next_action(void* h, RaftActionView* out) {
+  Core* c = static_cast<Core*>(h);
+  if (c->outbox.empty()) return 0;
+  c->current = std::move(c->outbox.front());
+  c->outbox.pop_front();
+  out->kind = c->current.kind;
+  out->peer = c->current.peer;
+  out->flag = c->current.flag;
+  out->a = c->current.a;
+  out->b = c->current.b;
+  out->c = c->current.c;
+  out->d = c->current.d;
+  out->data = reinterpret_cast<const uint8_t*>(c->current.data.data());
+  out->data_len = static_cast<uint32_t>(c->current.data.size());
+  return 1;
+}
+
+}  // extern "C"

@@ -25,3 +25,15 @@ import pathlib
 jax.config.update("jax_compilation_cache_dir",
                   str(pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+# Build the native engines (kvlog, raftcore) when a compiler is available so
+# the suite exercises the C++ paths, not just the Python fallbacks. Import
+# happens after this, so the ctypes loaders see fresh .so files.
+import subprocess
+
+_native_dir = pathlib.Path(__file__).resolve().parent.parent / "native"
+try:
+    subprocess.run(["make", "-C", str(_native_dir)], capture_output=True,
+                   timeout=120, check=False)
+except (OSError, subprocess.TimeoutExpired):
+    pass  # no toolchain: fallbacks cover the formats
