@@ -46,9 +46,24 @@ class RaftUniquenessProvider(UniquenessProvider):
     @staticmethod
     def build(node_id: str, peers: list[str], messaging,
               state_machine: DistributedImmutableMap | None = None,
-              seed: int | None = None) -> "RaftUniquenessProvider":
+              seed: int | None = None,
+              native: bool | None = None) -> "RaftUniquenessProvider":
+        """``native``: None auto-selects the C++ protocol core when built
+        (the kvstore engine-selection stance); True requires it; False forces
+        the pure-Python replica. Both are wire-compatible."""
         sm = state_machine if state_machine is not None else DistributedImmutableMap()
-        raft = RaftNode(node_id, peers, messaging, sm.apply, seed=seed)
+        if native is None or native:
+            from .raftcore import NATIVE_RAFT_AVAILABLE, NativeRaftNode
+            if NATIVE_RAFT_AVAILABLE:
+                raft = NativeRaftNode(node_id, peers, messaging, sm.apply,
+                                      seed=seed)
+            elif native:
+                raise RuntimeError(
+                    "native raft requested but libraftcore.so is not built")
+            else:
+                raft = RaftNode(node_id, peers, messaging, sm.apply, seed=seed)
+        else:
+            raft = RaftNode(node_id, peers, messaging, sm.apply, seed=seed)
         provider = RaftUniquenessProvider(raft)
         provider.state_machine = sm
         return provider

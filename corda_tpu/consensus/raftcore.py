@@ -142,9 +142,12 @@ class NativeRaftNode:
         self.index = self.names.index(node_id)
         self.messaging = messaging
         self.apply_fn = apply_fn
+        # seed None → 0: the core derives a per-replica seed from its index
+        # (distinct election timeouts, matching RaftNode's node_id seeding);
+        # an explicit seed is offset so seed=0 doesn't alias the fallback
         self._handle = _LIB.raft_create(
             self.index, len(self.names), 10, 20, 3,
-            (seed if seed is not None else 0) + 1)
+            0 if seed is None else seed + 1)
         if not self._handle:
             raise RuntimeError("raft_create failed (cluster too large?)")
         self._request_ids = iter(range(1, 1 << 62))

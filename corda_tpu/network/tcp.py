@@ -64,6 +64,7 @@ class TcpMessagingService(MessagingService):
         self._writers: dict[str, asyncio.StreamWriter] = {}
         self._send_queues: dict[str, "asyncio.Queue"] = {}
         self._sender_tasks: dict[str, "asyncio.Task"] = {}
+        self._stopping = False
         self._loop = asyncio.new_event_loop()
         self._server = None
         self._started = threading.Event()
@@ -141,6 +142,8 @@ class TcpMessagingService(MessagingService):
         to a peer stay ordered (the per-peer broker queue semantics), exactly
         one connection per peer exists, and a slow peer eventually blocks its
         producers instead of growing memory without bound."""
+        if self._stopping:   # a send racing stop() must not respawn senders
+            return
         q = self._send_queues.get(recipient)
         if q is None:
             q = self._send_queues[recipient] = asyncio.Queue(
@@ -200,6 +203,7 @@ class TcpMessagingService(MessagingService):
 
     def stop(self) -> None:
         async def _shutdown():
+            self._stopping = True   # set on the loop: gates _enqueue_send
             tasks = list(self._sender_tasks.values())
             for task in tasks:
                 task.cancel()

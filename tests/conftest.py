@@ -33,7 +33,13 @@ import subprocess
 
 _native_dir = pathlib.Path(__file__).resolve().parent.parent / "native"
 try:
-    subprocess.run(["make", "-C", str(_native_dir)], capture_output=True,
-                   timeout=120, check=False)
+    _mk = subprocess.run(["make", "-C", str(_native_dir)],
+                         capture_output=True, timeout=120, check=False)
+    if _mk.returncode != 0:
+        # a toolchain exists but the build BROKE: surface it loudly instead
+        # of letting skipif markers turn native coverage into silent skips
+        import sys
+        print("NATIVE BUILD FAILED:\n" + _mk.stderr.decode(errors="replace"),
+              file=sys.stderr)
 except (OSError, subprocess.TimeoutExpired):
     pass  # no toolchain: fallbacks cover the formats
