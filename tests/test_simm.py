@@ -1,0 +1,57 @@
+"""SIMM valuation demo tests (SimmValuationTest analog)."""
+import numpy as np
+import pytest
+
+from corda_tpu.flows import FlowException
+from corda_tpu.samples.simm_valuation import (AGREEMENT_TOLERANCE_CENTS,
+                                              RISK_WEIGHTS, SimmRevaluationFlow,
+                                              compute_margin_cents,
+                                              correlation_matrix,
+                                              demo_portfolio)
+from corda_tpu.testing import MockNetwork
+
+
+def numpy_margin_cents(sens) -> int:
+    ws = RISK_WEIGHTS * np.sum(np.asarray(sens, dtype=np.float32), axis=0)
+    return int(round(float(np.sqrt(ws @ correlation_matrix() @ ws)) * 100))
+
+
+def test_device_margin_matches_reference():
+    book = demo_portfolio()
+    got = compute_margin_cents(book)
+    want = numpy_margin_cents(book)
+    assert abs(got - want) <= 2      # float32 device vs host rounding
+    assert got > 0
+    # margin is subadditive in offsetting trades: netting reduces it
+    offset = np.concatenate([book, -book])
+    assert compute_margin_cents(offset) <= got
+
+
+def test_two_party_agreement():
+    network = MockNetwork()
+    a = network.create_node("O=Dealer A, L=London, C=GB")
+    b = network.create_node("O=Dealer B, L=New York, C=US")
+    network.start_nodes()
+    book = demo_portfolio()
+    fsm = a.start_flow(SimmRevaluationFlow(b.party, book))
+    network.run_network()
+    out = fsm.result_future.result(timeout=10)
+    assert abs(out["margin_cents"] - out["counterparty_margin"]) \
+        <= AGREEMENT_TOLERANCE_CENTS
+    assert out["signature"]          # counterparty signed the agreed figure
+
+
+def test_disagreement_refused(monkeypatch):
+    """A proposal outside the counterparty's tolerance gets no signature and
+    the initiator fails with the disagreement (tolerance forced negative so
+    even an exact match counts as out-of-tolerance)."""
+    import corda_tpu.samples.simm_valuation as simm
+    monkeypatch.setattr(simm, "AGREEMENT_TOLERANCE_CENTS", -1)
+    network = MockNetwork()
+    a = network.create_node("O=Dealer A, L=London, C=GB")
+    b = network.create_node("O=Dealer B, L=New York, C=US")
+    network.start_nodes()
+    fsm = a.start_flow(SimmRevaluationFlow(b.party, demo_portfolio()))
+    network.run_network()
+    with pytest.raises(FlowException, match="disagrees"):
+        fsm.result_future.result(timeout=10)
