@@ -375,10 +375,18 @@ def bucket_size(n: int, floor: int = 8) -> int:
 def scalars_to_bits(xs, nbits: int = 256) -> np.ndarray:
     """Python ints → (nbits, B) u32 bit array, MSB first (scan-ready layout:
     ladder kernels scan over the leading bit axis). Vectorized via unpackbits —
-    this runs on the host per batch, so no Python-level 256×B loop."""
-    nbytes = nbits // 8
+    this runs on the host per batch, so no Python-level 256×B loop.
+    ``nbits`` need not be byte-aligned: values are packed into the enclosing
+    byte count and the excess high-order rows sliced off (every scalar must
+    fit nbits — to_bytes raises otherwise)."""
+    nbytes = (nbits + 7) // 8
     packed = np.frombuffer(
         b"".join(int(x).to_bytes(nbytes, "big") for x in xs),
         dtype=np.uint8).reshape(len(xs), nbytes)
-    bits = np.unpackbits(packed, axis=1, bitorder="big")  # (B, nbits) MSB first
-    return np.ascontiguousarray(bits.T).astype(np.uint32)
+    bits = np.unpackbits(packed, axis=1, bitorder="big")  # (B, 8*nbytes) MSB
+    if nbits % 8:
+        # to_bytes only bounds by the byte count: reject (loudly, not by
+        # silent truncation) any scalar using the sliced-off high bits
+        assert not bits[:, : 8 * nbytes - nbits].any(), \
+            f"scalar exceeds {nbits} bits"
+    return np.ascontiguousarray(bits[:, -nbits:].T).astype(np.uint32)

@@ -192,8 +192,8 @@ def shamir_ladder(bits1, bits2, P1, P2, curve: WeierstrassCurve):
 # GLV path (secp256k1 only): 4-scalar joint ladder over 129 bits
 # ---------------------------------------------------------------------------
 
-GLV_BITS = 136  # |k1|,|k2| < 2^128; byte-aligned with headroom (int.to_bytes
-                # raises OverflowError if a decomposition ever exceeded this)
+GLV_BITS = 130  # |k1|,|k2| < 2^128 with small constant slack; int.to_bytes
+                # raises OverflowError if a decomposition ever exceeded this
 
 
 def glv_ladder(bits4, pts4, curve: WeierstrassCurve):
