@@ -124,12 +124,22 @@ class RaftNode:
     entry, in log order, on every replica."""
 
     def __init__(self, node_id: str, peers: list[str], messaging,
-                 apply_fn: Callable[[Any], Any], seed: int | None = None):
+                 apply_fn: Callable[[Any], Any], seed: int | None = None,
+                 storage=None):
+        """``storage``: an optional consensus.raft_store.RaftLogStore making
+        the replica's persistent state (term, vote, log) survive restarts —
+        Raft §5.1; the Copycat durable-storage role."""
         self.node_id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.messaging = messaging
         self.apply_fn = apply_fn
+        self.storage = storage
         self.state = RaftState()
+        if storage is not None:
+            term, vote, entries = storage.load()
+            self.state.current_term = term
+            self.state.voted_for = vote
+            self.state.log = entries
         self.role = FOLLOWER
         self.leader_id: str | None = None
         self._rng = random.Random(seed if seed is not None else node_id)
@@ -144,7 +154,13 @@ class RaftNode:
         # timer thread, messages from the transport thread, and submits from
         # flow threads all mutate the same state.
         self._lock = threading.RLock()
-        messaging.add_message_handler(TopicSession(TOPIC_RAFT), self._on_message)
+        self._registration = messaging.add_message_handler(
+            TopicSession(TOPIC_RAFT), self._on_message)
+
+    def stop(self) -> None:
+        """Detach from the transport (restart/teardown path: a revived
+        replica re-registers on the same endpoint)."""
+        self.messaging.remove_message_handler(self._registration)
 
     # -- timers --------------------------------------------------------------
     def _new_election_timeout(self) -> int:
@@ -166,11 +182,31 @@ class RaftNode:
         if self._election_deadline <= 0:
             self._start_election()
 
+    # -- persistence hooks ---------------------------------------------------
+    def _persist_meta(self) -> None:
+        if self.storage is not None:
+            self.storage.save_meta(self.state.current_term,
+                                   self.state.voted_for)
+
+    def _persist_append(self) -> None:
+        """Persist the entry just appended in memory."""
+        if self.storage is not None:
+            idx = self.state.last_index()
+            self.storage.append(idx, self.state.log[idx - 1])
+
+    def _persist_suffix(self, from_index: int) -> None:
+        """Persist a conflict overwrite: truncate + rewrite from_index on."""
+        if self.storage is not None:
+            self.storage.truncate_from(from_index)
+            for idx in range(from_index, self.state.last_index() + 1):
+                self.storage.append(idx, self.state.log[idx - 1])
+
     # -- elections -----------------------------------------------------------
     def _start_election(self) -> None:
         self.state.current_term += 1
         self.role = CANDIDATE
         self.state.voted_for = self.node_id
+        self._persist_meta()
         self._votes = {self.node_id}
         self._election_deadline = self._new_election_timeout()
         log.debug("%s starts election for term %d", self.node_id,
@@ -193,6 +229,7 @@ class RaftNode:
             # a current-term no-op lets _maybe_commit advance over entries
             # replicated in previous terms (Raft 5.4.2 liveness)
             self.state.log.append(LogEntry(self.state.current_term, NOOP))
+            self._persist_append()
             self._broadcast_append()
             self._maybe_commit()
 
@@ -242,6 +279,7 @@ class RaftNode:
             return
         self.state.log.append(LogEntry(self.state.current_term, req.entry,
                                        req.client, req.request_id))
+        self._persist_append()
         self._broadcast_append()
         self._maybe_commit()   # single-node cluster commits immediately
 
@@ -253,6 +291,7 @@ class RaftNode:
         if term > self.state.current_term:
             self.state.current_term = term
             self.state.voted_for = None
+            self._persist_meta()
             self.role = FOLLOWER
             self.leader_id = None  # stale until the new leader heartbeats
 
@@ -284,6 +323,7 @@ class RaftNode:
                  and self.state.voted_for in (None, m.candidate))
         if grant:
             self.state.voted_for = m.candidate
+            self._persist_meta()
             self._election_deadline = self._new_election_timeout()
         self._post(m.candidate, VoteResponse(self.state.current_term,
                                              self.node_id, grant))
@@ -310,7 +350,9 @@ class RaftNode:
                                                 self.node_id, False, 0))
             return
         # append / overwrite conflicting suffix
-        self.state.log = self.state.log[:m.prev_log_index] + list(m.entries)
+        if m.entries or self.state.last_index() > m.prev_log_index:
+            self.state.log = self.state.log[:m.prev_log_index] + list(m.entries)
+            self._persist_suffix(m.prev_log_index + 1)
         if m.leader_commit > self.state.commit_index:
             self.state.commit_index = min(m.leader_commit,
                                           self.state.last_index())

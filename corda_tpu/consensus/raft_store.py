@@ -1,0 +1,51 @@
+"""Durable Raft persistent state over the kvlog storage engine.
+
+Reference parity: the storage half of Copycat's Raft (the reference's
+RaftUniquenessProvider configures Copycat with durable storage so a notary
+cluster survives restarts). Raft's PERSISTENT state is exactly: currentTerm,
+votedFor, and the log (§5.1) — commit index and the applied state machine
+are volatile and re-derived (leader communicates commit; the
+DistributedImmutableMap replays on commit advance). That is what this store
+holds, one KvStore (native C++ engine when built) per replica.
+
+Keys: b"meta" → serialized [term, voted_for]; b"e%016d" → serialized
+LogEntry at that 1-based index. Truncation on conflict writes tombstones.
+"""
+from __future__ import annotations
+
+from ..core.serialization import deserialize, serialize
+from ..storage.kvstore import KvStore
+from .raft import LogEntry
+
+
+class RaftLogStore:
+    def __init__(self, path: str):
+        self._kv = KvStore(path)
+
+    @staticmethod
+    def _ekey(index: int) -> bytes:
+        return b"e%016d" % index
+
+    def save_meta(self, term: int, voted_for: str | None) -> None:
+        self._kv[b"meta"] = serialize([term, voted_for])
+
+    def append(self, index: int, entry: LogEntry) -> None:
+        self._kv[self._ekey(index)] = serialize(entry)
+
+    def truncate_from(self, index: int) -> None:
+        """Drop every entry at/after ``index`` (conflict overwrite)."""
+        for key in sorted(self._kv.keys()):
+            if key.startswith(b"e") and key >= self._ekey(index):
+                del self._kv[key]
+
+    def load(self) -> tuple[int, str | None, list[LogEntry]]:
+        meta = self._kv.get(b"meta")
+        term, voted_for = deserialize(meta) if meta is not None else (0, None)
+        entries = [
+            deserialize(self._kv[key])
+            for key in sorted(k for k in self._kv.keys() if k.startswith(b"e"))
+        ]
+        return term, voted_for, entries
+
+    def close(self) -> None:
+        self._kv.close()

@@ -46,13 +46,24 @@ class RaftUniquenessProvider(UniquenessProvider):
     @staticmethod
     def build(node_id: str, peers: list[str], messaging,
               state_machine: DistributedImmutableMap | None = None,
-              seed: int | None = None,
-              native: bool | None = None) -> "RaftUniquenessProvider":
+              seed: int | None = None, native: bool | None = None,
+              storage_path: str | None = None) -> "RaftUniquenessProvider":
         """``native``: None auto-selects the C++ protocol core when built
         (the kvstore engine-selection stance); True requires it; False forces
-        the pure-Python replica. Both are wire-compatible."""
+        the pure-Python replica. Both are wire-compatible.
+
+        ``storage_path``: persist the replica's Raft state (term/vote/log)
+        there so the cluster survives restarts — durable persistence is the
+        Python replica's feature, so it forces native off."""
         sm = state_machine if state_machine is not None else DistributedImmutableMap()
-        if native is None or native:
+        if storage_path is not None:
+            if native:
+                raise RuntimeError(
+                    "durable raft storage requires the Python replica")
+            from .raft_store import RaftLogStore
+            raft = RaftNode(node_id, peers, messaging, sm.apply, seed=seed,
+                            storage=RaftLogStore(storage_path))
+        elif native or native is None:
             from .raftcore import NATIVE_RAFT_AVAILABLE, NativeRaftNode
             if NATIVE_RAFT_AVAILABLE:
                 raft = NativeRaftNode(node_id, peers, messaging, sm.apply,

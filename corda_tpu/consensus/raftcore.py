@@ -153,8 +153,12 @@ class NativeRaftNode:
         self._request_ids = iter(range(1, 1 << 62))
         self._pending: dict[int, Future] = {}
         self._lock = threading.RLock()
-        messaging.add_message_handler(TopicSession(TOPIC_RAFT),
-                                      self._on_message)
+        self._registration = messaging.add_message_handler(
+            TopicSession(TOPIC_RAFT), self._on_message)
+
+    def stop(self) -> None:
+        """Detach from the transport (restart/teardown path)."""
+        self.messaging.remove_message_handler(self._registration)
 
     # -- properties mirroring RaftNode ---------------------------------------
     @property
