@@ -115,8 +115,24 @@ class NewView:
     requests: tuple       # re-proposal order — must re-derive from the quorum
 
 
+@dataclass(frozen=True)
+class StateRequest:
+    """Catch-up request from a replica whose watermark jumped past requests
+    it never applied (lagging beyond the certificate window)."""
+
+    replica: str
+    through: int          # requester's executed_through
+
+
+@dataclass(frozen=True)
+class StateResponse:
+    snapshot: bytes       # state-machine snapshot (snapshot_fn)
+    through: int          # seq the snapshot covers
+    executed_ids: tuple   # request-id dedup set at that point
+
+
 for _cls in (Request, PrePrepare, Prepare, CommitMsg, Reply, PreparedCert,
-             ViewChange, NewView):
+             ViewChange, NewView, StateRequest, StateResponse):
     register_type(f"bft.{_cls.__name__}", _cls)
 
 
@@ -128,7 +144,13 @@ class BFTReplica:
     """One of the 3f+1 replicas (BFTSMaRt.Replica / CordaServiceReplica)."""
 
     def __init__(self, replica_id: str, replicas: list[str], messaging,
-                 apply_fn: Callable[[Any], Any]):
+                 apply_fn: Callable[[Any], Any],
+                 snapshot_fn: Callable[[], bytes] | None = None,
+                 restore_fn: Callable[[bytes], None] | None = None,
+                 cert_retention: int = CERT_RETENTION):
+        """``snapshot_fn``/``restore_fn``: state-machine snapshot hooks
+        enabling state transfer for replicas that fall behind the
+        certificate window (DistributedImmutableMap.snapshot/restore)."""
         self.replica_id = replica_id
         self.replicas = list(replicas)
         self.index = replicas.index(replica_id)
@@ -136,6 +158,9 @@ class BFTReplica:
         self.f = (self.n - 1) // 3
         self.messaging = messaging
         self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.cert_retention = cert_retention
         self.view = 0
         self.next_seq = 0              # primary's sequence counter
         self.executed_through = -1
@@ -207,6 +232,10 @@ class BFTReplica:
                 self._on_view_change(m)
             elif isinstance(m, NewView):
                 self._on_new_view(m)
+            elif isinstance(m, StateRequest):
+                self._on_state_request(m)
+            elif isinstance(m, StateResponse):
+                self._on_state_response(m)
 
     def _on_request(self, req: Request) -> None:
         if req.request_id in self._executed_requests:
@@ -305,7 +334,7 @@ class BFTReplica:
         self._commits = {k: v for k, v in self._commits.items()
                          if k[1] > through}
         self._prepared = {s: c for s, c in self._prepared.items()
-                          if s > through - CERT_RETENTION}
+                          if s > through - self.cert_retention}
 
     # -- view change (certificate-carrying; see module docstring) ------------
     def _derive_requests(self, view_changes) -> tuple | None:
@@ -413,12 +442,52 @@ class BFTReplica:
         self._ticks_waiting = 0
         self._log = {s: pp for s, pp in self._log.items()
                      if s <= self.executed_through}
+        old = self.executed_through
         base = self._safe_next_seq(nv.view_changes)   # same jump as the leader
         self.executed_through = max(self.executed_through, base - 1)
         self._expected_order = [r.request_id for r in nv.requests]
         for req in nv.requests:
             if req.request_id not in self._executed_requests:
                 self._pending.setdefault(req.request_id, req)
+        if old < base - 1 - self.cert_retention and self.restore_fn is not None:
+            # the jump skipped seqs outside the certificate window: requests
+            # executed elsewhere that no re-proposal carries — catch up via
+            # state transfer from the new leader. The request carries the
+            # PRE-jump watermark (what we actually applied through).
+            self._applied_marker = old
+            self._state_request_mark = self.executed_through
+            self._send(self.primary, StateRequest(self.replica_id, old))
+
+
+    # -- state transfer (the BFT-SMaRt state-transfer role) ------------------
+    _state_request_mark: int | None = None
+    _applied_marker: int = -1
+
+    def _on_state_request(self, m: StateRequest) -> None:
+        if self.snapshot_fn is None or self.executed_through <= m.through:
+            return
+        self._send(m.replica, StateResponse(
+            self.snapshot_fn(), self.executed_through,
+            tuple(sorted(self._executed_requests))))
+
+    def _on_state_response(self, m: StateResponse) -> None:
+        if self.restore_fn is None or self._state_request_mark is None:
+            return
+        if self.executed_through != self._state_request_mark:
+            # we applied new commits since asking: that snapshot may miss
+            # them — ask again (the applied marker still lower-bounds what
+            # we could be missing)
+            self._state_request_mark = self.executed_through
+            self._send(self.primary,
+                       StateRequest(self.replica_id, self._applied_marker))
+            return
+        if m.through >= self.executed_through:
+            self.restore_fn(m.snapshot)
+            self._executed_requests.update(m.executed_ids)
+            self.executed_through = max(self.executed_through, m.through)
+            for rid in m.executed_ids:
+                self._pending.pop(rid, None)
+            self._state_request_mark = None
 
 
 class BFTClient:

@@ -34,6 +34,15 @@ class DistributedImmutableMap:
     def __len__(self):
         return len(self._map)
 
+    # -- state transfer (BFT catch-up / future raft snapshots) ---------------
+    def snapshot(self) -> bytes:
+        from ..core.serialization import serialize
+        return serialize(self._map)
+
+    def restore(self, blob: bytes) -> None:
+        from ..core.serialization import deserialize
+        self._map = dict(deserialize(blob))
+
 
 class RaftUniquenessProvider(UniquenessProvider):
     """UniquenessProvider backed by a RaftNode; `commit` blocks on consensus

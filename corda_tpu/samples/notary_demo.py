@@ -180,7 +180,9 @@ def run_bft_demo(rounds: int = 2):
     names = [f"bft{i}" for i in range(4)]
     machines = [DistributedImmutableMap() for _ in names]
     replicas = [BFTReplica(n, names, network.bus.create_node(n),
-                           machines[i].apply)
+                           machines[i].apply,
+                           snapshot_fn=machines[i].snapshot,
+                           restore_fn=machines[i].restore)
                 for i, n in enumerate(names)]
     client = BFTClient("bft-client", names,
                        network.bus.create_node("bft-client"))
