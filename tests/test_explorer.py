@@ -1,0 +1,35 @@
+"""Explorer terminal dashboard tests (tools/explorer analog)."""
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.finance import CashIssueFlow
+from corda_tpu.node.rpc import CordaRPCOps
+from corda_tpu.samples.simulation import Simulation
+from corda_tpu.testing import MockNetwork
+from corda_tpu.tools.explorer import Explorer
+
+
+def test_render_dashboard():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    bank = network.create_node("O=Bank, L=London, C=GB")
+    network.start_nodes()
+    ops = CordaRPCOps(bank.services, bank.smm)
+    fsm = bank.start_flow(CashIssueFlow(Amount(123400, USD), b"\x01",
+                                        bank.party, notary.party))
+    network.run_network()
+    fsm.result_future.result(timeout=5)
+
+    out = Explorer(ops).render()
+    assert "O=Bank, L=London, C=GB" in out
+    assert "2 nodes" in out and "1 notaries" in out
+    assert "CashState" in out and "total 123400" in out
+    assert "1 verified transactions" in out
+    assert "flows started: 1" in out
+
+
+def test_watch_renders_over_simulation(capsys):
+    sim = Simulation(n_banks=2, seed=3, issue_cents=100_00).run(steps=2)
+    ops = CordaRPCOps(sim.banks[0].services, sim.banks[0].smm)
+    Explorer(ops).watch(interval_s=0.0, iterations=2)
+    printed = capsys.readouterr().out
+    assert printed.count("VAULT") == 2        # two live frames
+    assert "Bank A" in printed
