@@ -11,6 +11,7 @@ from .sharded import (  # noqa: F401
     make_mesh,
     sharded_ed25519_verify,
     sharded_ecdsa_verify,
+    sharded_ecdsa_verify_hybrid,
     sharded_merkle_root,
     tx_verify_step,
 )

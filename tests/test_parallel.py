@@ -13,8 +13,9 @@ import pytest
 from corda_tpu.core.crypto import ecmath
 from corda_tpu.ops import ed25519 as ed_ops
 from corda_tpu.ops import sha256 as sha_ops
-from corda_tpu.parallel import (make_mesh, sharded_ed25519_verify,
-                                sharded_merkle_root, tx_verify_step)
+from corda_tpu.parallel import (make_mesh, sharded_ecdsa_verify_hybrid,
+                                sharded_ed25519_verify, sharded_merkle_root,
+                                tx_verify_step)
 
 RNG = np.random.default_rng(11)
 
@@ -44,6 +45,26 @@ def test_sharded_ed25519_matches_host(mesh):
     s_bits, k_bits, neg_a, r_affine, precheck = ed_ops.prepare_batch(items)
     fn = sharded_ed25519_verify(mesh)
     ok = np.asarray(fn(s_bits, k_bits, neg_a, r_affine)) & precheck
+    assert list(ok) == want
+    assert True in ok and False in list(ok)
+
+
+def test_sharded_hybrid_ecdsa_matches_host(mesh):
+    from corda_tpu.ops import weierstrass as wc_ops
+    curve = ecmath.SECP256K1
+    items, want = [], []
+    for i in range(16):
+        priv = int.from_bytes(RNG.bytes(32), "little") % (curve.n - 1) + 1
+        pub = curve.mul(priv, curve.g)
+        msg = RNG.bytes(24 + i)
+        r, s = ecmath.ecdsa_sign(curve, priv, msg)
+        if i % 3 == 1:
+            msg = msg + b"x"
+        items.append((pub, msg, r, s))
+        want.append(ecmath.ecdsa_verify(curve, pub, msg, r, s))
+    *args, precheck = wc_ops.prepare_batch_hybrid(items)
+    fn = sharded_ecdsa_verify_hybrid(mesh)
+    ok = np.asarray(fn(*args)) & precheck
     assert list(ok) == want
     assert True in ok and False in list(ok)
 
