@@ -69,6 +69,91 @@ def test_crypto_types_roundtrip():
     assert deserialize(serialize(comp)) == comp
 
 
+def test_fuzz_mutated_bytes_fail_typed():
+    """Untrusted wire bytes: random mutations of valid canonical bytes must
+    either deserialize (benign mutation) or raise SerializationError — never
+    any other exception type (the deserialize() hardening contract)."""
+    import numpy as np
+
+    from corda_tpu.core.crypto.secure_hash import SecureHash
+    from corda_tpu.core.serialization import (SerializationError, deserialize,
+                                              serialize)
+
+    base = serialize({
+        "refs": [SecureHash.sha256(bytes([i])) for i in range(4)],
+        "amounts": [10**20, -5, 0],
+        "nested": {"a": (1, 2, b"\x00\xff"), "b": frozenset((1, 2, 3))},
+    })
+    rng = np.random.default_rng(99)
+    survived, rejected = 0, 0
+    for _ in range(500):
+        mutated = bytearray(base)
+        for _ in range(int(rng.integers(1, 4))):
+            mutated[int(rng.integers(0, len(base)))] = int(rng.integers(256))
+        try:
+            deserialize(bytes(mutated))
+            survived += 1
+        except SerializationError:
+            rejected += 1
+    assert survived + rejected == 500
+    assert rejected > 0           # sanity: mutations do get caught
+
+    # truncations at every boundary fail typed too
+    for cut in range(len(base)):
+        try:
+            deserialize(base[:cut])
+        except SerializationError:
+            pass
+
+
+def test_fuzz_random_structures_roundtrip():
+    """Property: generator-built random wire trees round-trip exactly."""
+    import numpy as np
+
+    from corda_tpu.core.serialization import deserialize, serialize
+
+    rng = np.random.default_rng(17)
+
+    def random_value(depth=0):
+        kinds = ["int", "bigint", "str", "bytes", "bool", "none"]
+        if depth < 3:
+            kinds += ["list", "dict"] * 2
+        kind = kinds[int(rng.integers(len(kinds)))]
+        if kind == "int":
+            return int(rng.integers(-2**62, 2**62))
+        if kind == "bigint":
+            return int(rng.integers(0, 2**62)) << int(rng.integers(64, 200))
+        if kind == "str":
+            return "".join(chr(0x20 + int(c) % 0x5F)
+                           for c in rng.integers(0, 255, size=8))
+        if kind == "bytes":
+            return bytes(rng.integers(0, 255, size=int(rng.integers(0, 16)),
+                                      dtype=np.uint8))
+        if kind == "bool":
+            return bool(rng.integers(2))
+        if kind == "none":
+            return None
+        if kind == "list":
+            return [random_value(depth + 1)
+                    for _ in range(int(rng.integers(0, 4)))]
+        return {f"k{i}": random_value(depth + 1)
+                for i in range(int(rng.integers(0, 4)))}
+
+    for _ in range(100):
+        value = random_value()
+        back = deserialize(serialize(value))
+        norm = _normalize_tuples(value)
+        assert back == norm, (value, back)
+
+
+def _normalize_tuples(v):
+    if isinstance(v, (list, tuple)):
+        return [_normalize_tuples(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _normalize_tuples(x) for k, x in v.items()}
+    return v
+
+
 def test_registered_dataclass_roundtrip():
     from corda_tpu.testing import DummyState
     kp = generate_keypair(entropy=b"\x0b" * 32)
