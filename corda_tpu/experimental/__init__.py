@@ -1,0 +1,1 @@
+"""Experimental subsystems (the reference's experimental/ tree)."""

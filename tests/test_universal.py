@@ -1,0 +1,134 @@
+"""Universal-contracts DSL tests (experimental UniversalContract analog).
+
+A zero-coupon-bond-like agreement and an FX-barrier-like agreement built
+from the arrangement algebra, verified through the ledger DSL: correct
+transitions pass; early exercise, wrong actors, wrong continuations, and
+missing fixings fail.
+"""
+import datetime
+
+import pytest
+
+from corda_tpu.core.contracts.structures import TimeWindow
+from corda_tpu.core.crypto import generate_keypair
+from corda_tpu.core.identity import Party
+from corda_tpu.experimental.universal import (Action, Actions, All, Issue,
+                                              Move, Transfer, UniversalState,
+                                              Zero, after, const, fixing)
+from corda_tpu.testing.ledger_dsl import ledger
+
+NOTARY = Party("O=Notary, L=Zurich, C=CH",
+               generate_keypair(entropy=b"\x81" * 32).public)
+ACME_KP = generate_keypair(entropy=b"\x82" * 32)
+OWNER_KP = generate_keypair(entropy=b"\x83" * 32)
+
+T0 = datetime.datetime(2026, 7, 1, tzinfo=datetime.timezone.utc)
+MATURITY = datetime.datetime(2026, 12, 1, tzinfo=datetime.timezone.utc)
+
+
+def window(at):
+    return TimeWindow.with_tolerance(at, datetime.timedelta(seconds=30))
+
+
+def bond():
+    """Zero-coupon bond: after maturity the owner may demand 100 USD from
+    ACME, ending the agreement."""
+    redemption = Transfer(const(100_00), "USD", ACME_KP.public,
+                          OWNER_KP.public)
+    return Actions({
+        "redeem": Action(OWNER_KP.public, after(MATURITY),
+                         All((redemption,))),
+    })
+
+
+def test_bond_lifecycle():
+    state = UniversalState(bond(), (ACME_KP.public, OWNER_KP.public))
+    paid = UniversalState(All((Transfer(const(100_00), "USD",
+                                        ACME_KP.public, OWNER_KP.public),)),
+                          (ACME_KP.public, OWNER_KP.public))
+    with ledger(NOTARY) as l:
+        with l.transaction() as tx:     # issuance signed by the liable party
+            tx.output("bond", state)
+            tx.command(Issue(), ACME_KP.public)
+            tx.verifies()
+        with l.transaction() as tx:     # early redemption fails
+            tx.input("bond")
+            tx.output(None, paid)
+            tx.command(Move("redeem"), OWNER_KP.public)
+            tx.time_window(window(T0))
+            tx.fails_with("condition")
+        with l.transaction() as tx:     # wrong actor fails
+            tx.input("bond")
+            tx.output(None, paid)
+            tx.command(Move("redeem"), ACME_KP.public)
+            tx.time_window(window(MATURITY + datetime.timedelta(days=1)))
+            tx.fails_with("actor")
+        with l.transaction() as tx:     # wrong continuation fails
+            tx.input("bond")
+            tx.output(None, UniversalState(Zero(), state.parties))
+            tx.command(Move("redeem"), OWNER_KP.public)
+            tx.time_window(window(MATURITY + datetime.timedelta(days=1)))
+            tx.fails_with("continuation")
+        with l.transaction() as tx:     # proper redemption verifies
+            tx.input("bond")
+            tx.output("obligation", paid)
+            tx.command(Move("redeem"), OWNER_KP.public)
+            tx.time_window(window(MATURITY + datetime.timedelta(days=1)))
+            tx.verifies()
+
+
+def test_issuance_needs_liable_signature():
+    state = UniversalState(bond(), (ACME_KP.public, OWNER_KP.public))
+    with ledger(NOTARY) as l:
+        with l.transaction() as tx:
+            tx.output(None, state)
+            tx.command(Issue(), OWNER_KP.public)   # ACME (liable) didn't sign
+            tx.fails_with("liable")
+
+
+def test_fixing_condition():
+    """Barrier-style action: exercisable only when the observed rate fixing
+    clears the strike — and unexercisable without the fixing at all."""
+    arrangement = Actions({
+        "exercise": Action(
+            OWNER_KP.public,
+            fixing("EURUSD").ge(const(11000)),     # 1.1000 in pips
+            Zero()),
+    })
+    state = UniversalState(arrangement, (ACME_KP.public, OWNER_KP.public))
+    with ledger(NOTARY) as l:
+        with l.transaction() as tx:
+            tx.output("opt", state)
+            tx.command(Issue(), ACME_KP.public, OWNER_KP.public)
+            tx.verifies()
+        with l.transaction() as tx:     # no fixing provided
+            tx.input("opt")
+            tx.command(Move("exercise"), OWNER_KP.public)
+            tx.time_window(window(T0))
+            tx.fails_with("fixing")
+        with l.transaction() as tx:     # below the barrier
+            tx.input("opt")
+            tx.command(Move("exercise", {"EURUSD": 10500}), OWNER_KP.public)
+            tx.time_window(window(T0))
+            tx.fails_with("condition")
+        with l.transaction() as tx:     # above the barrier: agreement ends
+            tx.input("opt")
+            tx.command(Move("exercise", {"EURUSD": 11250}), OWNER_KP.public)
+            tx.time_window(window(T0))
+            tx.verifies()
+
+
+def test_perceivable_algebra():
+    from corda_tpu.experimental.universal import ValuationContext
+    ctx = ValuationContext(T0, {"r": 250})
+    expr = (fixing("r") * const(2) + const(100)).ge(const(600))
+    assert expr.value(ctx) is True
+    assert (fixing("r").lt(const(100))).value(ctx) is False
+    assert (after(MATURITY)).value(ctx) is False
+    assert (after(T0)).value(ctx) is True
+
+
+def test_arrangement_roundtrips_canonically():
+    from corda_tpu.core.serialization import deserialize, serialize
+    state = UniversalState(bond(), (ACME_KP.public, OWNER_KP.public))
+    assert deserialize(serialize(state)) == state
