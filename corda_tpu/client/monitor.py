@@ -1,0 +1,108 @@
+"""NodeMonitorModel — one subscription point turning RPC feeds into live
+observable models.
+
+Reference parity: client/jfx's NodeMonitorModel + the observable-value
+utilities (client/jfx/.../Models.kt, NodeMonitorModel tracking vault,
+transactions, state-machine progress per flow over RPC). JavaFX property
+bindings become plain observable lists/values with callbacks — the same
+aggregation layer the explorer/GUI consumed, usable from any Python UI,
+notebook, or test.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+
+class ObservableValue:
+    """A value plus change callbacks (the Property binding analog)."""
+
+    def __init__(self, initial: Any = None):
+        self._lock = threading.Lock()
+        self._value = initial
+        self._observers: list[Callable] = []
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def set(self, value) -> None:
+        with self._lock:
+            self._value = value
+            observers = list(self._observers)
+        for cb in observers:
+            cb(value)
+
+    def observe(self, cb: Callable) -> None:
+        self._observers.append(cb)
+
+
+class ObservableList:
+    """An append-only observable list (the ObservableList utilities role)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items: list = []
+        self._observers: list[Callable] = []
+
+    def append(self, item) -> None:
+        with self._lock:
+            self._items.append(item)
+            observers = list(self._observers)
+        for cb in observers:
+            cb(item)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._items)
+
+    def observe(self, cb: Callable) -> None:
+        self._observers.append(cb)
+
+    def __len__(self):
+        return len(self._items)
+
+
+class NodeMonitorModel:
+    """Subscribe once, read live models: state-machine events, vault
+    updates, verified transactions, and derived aggregates."""
+
+    def __init__(self):
+        self.state_machine_events = ObservableList()   # ("add"/"remove", info)
+        self.vault_updates = ObservableList()          # VaultUpdate
+        self.transactions = ObservableList()           # SignedTransaction
+        self.in_flight_flows = ObservableValue(0)
+        self.tx_count = ObservableValue(0)
+
+    def register(self, ops) -> "NodeMonitorModel":
+        """Wire every feed of a CordaRPCOps (in-process or remote proxy) —
+        NodeMonitorModel.register semantics: snapshots first, then deltas."""
+        sm_feed = ops.state_machines_feed()
+        for info in sm_feed.snapshot:
+            self.state_machine_events.append(("add", info))
+        self._recount(sm_feed.snapshot)
+        sm_feed.subscribe(self._on_sm_event)
+
+        vault_feed = ops.vault_feed()
+        vault_feed.subscribe(self.vault_updates.append)
+
+        tx_feed = ops.verified_transactions_feed()
+        for stx in tx_feed.snapshot:
+            self.transactions.append(stx)
+        self.tx_count.set(len(tx_feed.snapshot))
+        tx_feed.subscribe(self._on_tx)
+        return self
+
+    def _recount(self, infos) -> None:
+        self.in_flight_flows.set(sum(1 for i in infos if not i.done))
+
+    def _on_sm_event(self, event) -> None:
+        kind, info = event
+        self.state_machine_events.append((kind, info))
+        delta = 1 if kind == "add" else -1
+        self.in_flight_flows.set(max(0, self.in_flight_flows.value + delta))
+
+    def _on_tx(self, stx) -> None:
+        self.transactions.append(stx)
+        self.tx_count.set(self.tx_count.value + 1)

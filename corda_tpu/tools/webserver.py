@@ -13,9 +13,12 @@ app APIs and static content. Endpoints:
     GET  /api/metrics           metric registry snapshot (JSON)
     GET  /metrics               same, Prometheus text exposition format
     POST /api/flows/<FlowName>  body: JSON list of args -> run id / result
+    GET  /web/<app>/<path>      static app content (staticServeDirs role)
 
 Values render through a JSON-ifier that understands the framework's types
-(parties, amounts, hashes, states) — the client/jackson role.
+(parties, amounts, hashes, states) — the client/jackson role. Static dirs
+come from ``static_dirs={"app-name": "/path/to/dir"}`` (the CordaPluginRegistry
+staticServeDirs mapping, CordaPluginRegistry.kt:26).
 """
 from __future__ import annotations
 
@@ -95,9 +98,10 @@ class NodeWebServer:
     """Serve a CordaRPCOps (in-process) or CordaRPCClient (remote node)."""
 
     def __init__(self, ops, host: str = "127.0.0.1", port: int = 0,
-                 pump=None):
+                 pump=None, static_dirs: dict | None = None):
         self.ops = ops
         self.pump = pump          # MockNetwork.run_network for in-process use
+        self.static_dirs = dict(static_dirs or {})
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -113,6 +117,18 @@ class NodeWebServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                if self.path.startswith("/web/"):
+                    served = server.serve_static(self.path)
+                    if served is None:
+                        self._reply(404, {"error": f"not found: {self.path}"})
+                    else:
+                        ctype, body = served
+                        self.send_response(200)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    return
                 if self.path == "/metrics":   # Prometheus scrape endpoint
                     try:
                         body = prometheus_text(server.ops.metrics_snapshot()
@@ -193,6 +209,26 @@ class NodeWebServer:
                     out["error"] = f"{type(e).__name__}: {e}"
             return out
         raise RouteNotFound(path)
+
+    def serve_static(self, path: str):
+        """/web/<app>/<file...> → (content type, bytes) from the app's
+        registered static dir, or None. Resolved paths must stay inside the
+        registered directory (traversal-safe)."""
+        import mimetypes
+        import os
+        parts = path[len("/web/"):].split("/", 1)
+        app = parts[0]
+        rel = parts[1] if len(parts) > 1 and parts[1] else "index.html"
+        root = self.static_dirs.get(app)
+        if root is None:
+            return None
+        root = os.path.abspath(root)
+        full = os.path.abspath(os.path.join(root, rel))
+        if not full.startswith(root + os.sep) or not os.path.isfile(full):
+            return None
+        ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"
+        with open(full, "rb") as f:
+            return ctype, f.read()
 
     def _parse_arg(self, arg):
         """JSON arg → framework value: {"amount": n, "currency": "USD"},

@@ -1,0 +1,67 @@
+"""NodeMonitorModel + webserver static serving tests (client/jfx +
+staticServeDirs analogs)."""
+import json
+import urllib.request
+
+import pytest
+
+from corda_tpu.client.monitor import NodeMonitorModel
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+from corda_tpu.node.rpc import CordaRPCOps
+from corda_tpu.testing import MockNetwork
+from corda_tpu.tools.webserver import NodeWebServer
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    bank = network.create_node("O=Bank, L=London, C=GB")
+    network.start_nodes()
+    return network, notary, bank
+
+
+def test_monitor_model_tracks_feeds(net):
+    network, notary, bank = net
+    ops = CordaRPCOps(bank.services, bank.smm)
+    model = NodeMonitorModel().register(ops)
+    assert model.tx_count.value == 0
+
+    counts = []
+    model.tx_count.observe(counts.append)
+    fsm = bank.start_flow(CashIssueFlow(Amount(5000, USD), b"\x01",
+                                        bank.party, notary.party))
+    network.run_network()
+    fsm.result_future.result(timeout=5)
+
+    assert model.tx_count.value == 1 and counts[-1] == 1
+    assert len(model.transactions) == 1
+    assert model.vault_updates.snapshot()[0].produced
+    kinds = [k for k, _ in model.state_machine_events.snapshot()]
+    assert "add" in kinds and "remove" in kinds
+    assert model.in_flight_flows.value == 0
+
+
+def test_webserver_static_dirs(tmp_path, net):
+    network, notary, bank = net
+    app = tmp_path / "webapp"
+    app.mkdir()
+    (app / "index.html").write_text("<h1>corda-tpu</h1>")
+    (app / "app.js").write_text("console.log('hi')")
+    ops = CordaRPCOps(bank.services, bank.smm)
+    server = NodeWebServer(ops, static_dirs={"demo": str(app)}).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/web/demo/", timeout=10) as r:
+            assert b"corda-tpu" in r.read()
+            assert r.headers["Content-Type"].startswith("text/html")
+        with urllib.request.urlopen(f"{base}/web/demo/app.js", timeout=10) as r:
+            assert b"console" in r.read()
+        # traversal out of the app dir is refused
+        for bad in ("/web/demo/../secret", "/web/demo/%2e%2e/x",
+                    "/web/nope/index.html"):
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}{bad}", timeout=10)
+    finally:
+        server.stop()
