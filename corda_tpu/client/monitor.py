@@ -34,6 +34,15 @@ class ObservableValue:
         for cb in observers:
             cb(value)
 
+    def update(self, fn: Callable[[Any], Any]) -> None:
+        """Atomic read-modify-write (concurrent feed callbacks must not lose
+        increments)."""
+        with self._lock:
+            self._value = value = fn(self._value)
+            observers = list(self._observers)
+        for cb in observers:
+            cb(value)
+
     def observe(self, cb: Callable) -> None:
         self._observers.append(cb)
 
@@ -76,33 +85,47 @@ class NodeMonitorModel:
         self.tx_count = ObservableValue(0)
 
     def register(self, ops) -> "NodeMonitorModel":
-        """Wire every feed of a CordaRPCOps (in-process or remote proxy) —
-        NodeMonitorModel.register semantics: snapshots first, then deltas."""
+        """Wire every feed of a CordaRPCOps (in-process or remote proxy).
+        Subscriptions attach BEFORE snapshot seeding and seeding dedupes by
+        transaction id, so events landing in the snapshot/subscribe gap are
+        neither lost nor double-counted."""
+        self._seen_tx = set()
+        self._seen_sm = set()
         sm_feed = ops.state_machines_feed()
-        for info in sm_feed.snapshot:
-            self.state_machine_events.append(("add", info))
-        self._recount(sm_feed.snapshot)
         sm_feed.subscribe(self._on_sm_event)
+        for info in list(sm_feed.snapshot):
+            self._on_sm_event(("add", info))
+            if info.done:
+                self._on_sm_event(("remove", info))
 
         vault_feed = ops.vault_feed()
         vault_feed.subscribe(self.vault_updates.append)
+        if vault_feed.snapshot:
+            # fold the pre-existing holdings into one initial update
+            # (the reference's initial Vault.Update from the snapshot)
+            from ..node.vault import VaultUpdate
+            self.vault_updates.append(
+                VaultUpdate((), tuple(vault_feed.snapshot)))
 
         tx_feed = ops.verified_transactions_feed()
-        for stx in tx_feed.snapshot:
-            self.transactions.append(stx)
-        self.tx_count.set(len(tx_feed.snapshot))
         tx_feed.subscribe(self._on_tx)
+        for stx in list(tx_feed.snapshot):
+            self._on_tx(stx)
         return self
-
-    def _recount(self, infos) -> None:
-        self.in_flight_flows.set(sum(1 for i in infos if not i.done))
 
     def _on_sm_event(self, event) -> None:
         kind, info = event
+        key = (kind, info.run_id)
+        if key in self._seen_sm:   # seeded AND delivered live: count once
+            return
+        self._seen_sm.add(key)
         self.state_machine_events.append((kind, info))
         delta = 1 if kind == "add" else -1
-        self.in_flight_flows.set(max(0, self.in_flight_flows.value + delta))
+        self.in_flight_flows.update(lambda v: max(0, v + delta))
 
     def _on_tx(self, stx) -> None:
+        if stx.id in self._seen_tx:
+            return
+        self._seen_tx.add(stx.id)
         self.transactions.append(stx)
-        self.tx_count.set(self.tx_count.value + 1)
+        self.tx_count.update(lambda v: v + 1)

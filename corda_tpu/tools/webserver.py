@@ -108,13 +108,16 @@ class NodeWebServer:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _reply(self, code: int, payload) -> None:
-                body = json.dumps(payload, indent=2).encode()
+            def _reply_raw(self, code: int, ctype: str, body: bytes) -> None:
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _reply(self, code: int, payload) -> None:
+                self._reply_raw(code, "application/json",
+                                json.dumps(payload, indent=2).encode())
 
             def do_GET(self):
                 if self.path.startswith("/web/"):
@@ -122,23 +125,14 @@ class NodeWebServer:
                     if served is None:
                         self._reply(404, {"error": f"not found: {self.path}"})
                     else:
-                        ctype, body = served
-                        self.send_response(200)
-                        self.send_header("Content-Type", ctype)
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        self._reply_raw(200, *served)
                     return
                 if self.path == "/metrics":   # Prometheus scrape endpoint
                     try:
-                        body = prometheus_text(server.ops.metrics_snapshot()
-                                               ).encode()
-                        self.send_response(200)
-                        self.send_header("Content-Type",
-                                         "text/plain; version=0.0.4")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
+                        self._reply_raw(
+                            200, "text/plain; version=0.0.4",
+                            prometheus_text(server.ops.metrics_snapshot()
+                                            ).encode())
                     except Exception as e:
                         self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
@@ -212,18 +206,22 @@ class NodeWebServer:
 
     def serve_static(self, path: str):
         """/web/<app>/<file...> → (content type, bytes) from the app's
-        registered static dir, or None. Resolved paths must stay inside the
-        registered directory (traversal-safe)."""
+        registered static dir, or None. Query strings are stripped, percent
+        escapes decoded, and the REAL resolved path (symlinks followed) must
+        stay inside the registered directory — traversal-safe even against a
+        symlink planted in the app dir."""
         import mimetypes
         import os
+        from urllib.parse import unquote, urlsplit
+        path = unquote(urlsplit(path).path)
         parts = path[len("/web/"):].split("/", 1)
         app = parts[0]
         rel = parts[1] if len(parts) > 1 and parts[1] else "index.html"
         root = self.static_dirs.get(app)
         if root is None:
             return None
-        root = os.path.abspath(root)
-        full = os.path.abspath(os.path.join(root, rel))
+        root = os.path.realpath(root)
+        full = os.path.realpath(os.path.join(root, rel))
         if not full.startswith(root + os.sep) or not os.path.isfile(full):
             return None
         ctype = mimetypes.guess_type(full)[0] or "application/octet-stream"

@@ -42,6 +42,13 @@ def test_monitor_model_tracks_feeds(net):
     assert "add" in kinds and "remove" in kinds
     assert model.in_flight_flows.value == 0
 
+    # late registration folds existing state in: the snapshot becomes an
+    # initial vault update and transactions seed exactly once (deduped)
+    late = NodeMonitorModel().register(ops)
+    assert late.tx_count.value == 1
+    assert late.vault_updates.snapshot()[0].produced
+    assert late.in_flight_flows.value == 0
+
 
 def test_webserver_static_dirs(tmp_path, net):
     network, notary, bank = net
@@ -58,6 +65,18 @@ def test_webserver_static_dirs(tmp_path, net):
             assert r.headers["Content-Type"].startswith("text/html")
         with urllib.request.urlopen(f"{base}/web/demo/app.js", timeout=10) as r:
             assert b"console" in r.read()
+        # query strings (cache busting) and percent escapes resolve
+        with urllib.request.urlopen(f"{base}/web/demo/app.js?v=123",
+                                    timeout=10) as r:
+            assert b"console" in r.read()
+        with urllib.request.urlopen(f"{base}/web/demo/app%2Ejs",
+                                    timeout=10) as r:
+            assert b"console" in r.read()
+        # a symlink escaping the app dir is refused (realpath containment)
+        import os
+        os.symlink("/etc", str(app / "esc"))
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/web/demo/esc/hostname", timeout=10)
         # traversal out of the app dir is refused
         for bad in ("/web/demo/../secret", "/web/demo/%2e%2e/x",
                     "/web/nope/index.html"):
