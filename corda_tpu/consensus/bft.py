@@ -25,11 +25,13 @@ makes re-execution of already-applied requests a no-op.
 
 Documented simplifications vs full PBFT: (a) message authenticity comes
 from the transport (mutual-TLS peer identity / the in-memory bus), not
-per-message signatures; (b) the stable-checkpoint + state-transfer
-subsystem is replaced by a certificate retention window
-(CERT_RETENTION executed sequences) — a correct replica lagging by more
-than the window needs state transfer, which is delegated to the layer
-above exactly as the reference delegates it to BFT-SMaRt's state transfer.
+per-message signatures; (b) the stable-checkpoint subsystem is replaced
+by a certificate retention window (CERT_RETENTION executed sequences); a
+correct replica lagging by more than the window catches up via the built-in
+state transfer — it asks EVERY other replica and installs a snapshot only
+once f+1 distinct replicas return byte-identical state, so a single
+Byzantine responder (including a Byzantine new primary) cannot install
+fabricated notary state (PBFT §4.6 shape).
 """
 from __future__ import annotations
 
@@ -48,6 +50,7 @@ log = logging.getLogger(__name__)
 TOPIC_BFT = "platform.bft"
 
 VIEW_CHANGE_TICKS = 20
+STATE_RETRY_TICKS = 10  # re-poll cadence while a state transfer is pending
 CERT_RETENTION = 256   # executed seqs whose prepared certs are retained
                        # (the stable-checkpoint-window analog)
 
@@ -126,6 +129,7 @@ class StateRequest:
 
 @dataclass(frozen=True)
 class StateResponse:
+    replica: str          # responder (transport-authenticated identity)
     snapshot: bytes       # state-machine snapshot (snapshot_fn)
     through: int          # seq the snapshot covers
     executed_ids: tuple   # request-id dedup set at that point
@@ -200,6 +204,15 @@ class BFTReplica:
     # -- liveness ------------------------------------------------------------
     def tick(self) -> None:
         with self._lock:
+            if self._state_request_mark is not None:
+                # pending state transfer: re-poll until f+1 replicas answer
+                # with identical state. Responders at different watermarks
+                # hash to different vote keys, so a tally can stall while
+                # the cluster is mid-flight; once it quiesces the snapshots
+                # converge and a retry completes the install.
+                self._st_ticks += 1
+                if self._st_ticks >= STATE_RETRY_TICKS:
+                    self._request_state()
             if self._pending and not self.is_primary:
                 self._ticks_waiting += 1
                 if self._ticks_waiting >= VIEW_CHANGE_TICKS:
@@ -216,9 +229,9 @@ class BFTReplica:
 
     # -- message handling ----------------------------------------------------
     def _on_message(self, msg) -> None:
-        self._handle(deserialize(msg.data))
+        self._handle(deserialize(msg.data), sender=msg.sender)
 
-    def _handle(self, m) -> None:
+    def _handle(self, m, sender: str | None = None) -> None:
         with self._lock:
             if isinstance(m, Request):
                 self._on_request(m)
@@ -235,7 +248,7 @@ class BFTReplica:
             elif isinstance(m, StateRequest):
                 self._on_state_request(m)
             elif isinstance(m, StateResponse):
-                self._on_state_response(m)
+                self._on_state_response(m, sender)
 
     def _on_request(self, req: Request) -> None:
         if req.request_id in self._executed_requests:
@@ -407,9 +420,14 @@ class BFTReplica:
             # committed. Jump the execution watermark there — sequences below
             # it can never commit in this view, and every request that might
             # have committed in one rides the certified re-proposals.
+            old = self.executed_through
             base = self._safe_next_seq(quorum)
             self.next_seq = base
             self.executed_through = max(self.executed_through, base - 1)
+            # a leader that lagged beyond the certificate window must catch
+            # up too (ADVICE r1): it would otherwise serve snapshots from a
+            # deficient state machine
+            self._maybe_request_state(old, base)
             self._broadcast(NewView(vc.new_view, quorum, reqs))
             for req in reqs:
                 self._propose(req)
@@ -449,45 +467,90 @@ class BFTReplica:
         for req in nv.requests:
             if req.request_id not in self._executed_requests:
                 self._pending.setdefault(req.request_id, req)
-        if old < base - 1 - self.cert_retention and self.restore_fn is not None:
-            # the jump skipped seqs outside the certificate window: requests
-            # executed elsewhere that no re-proposal carries — catch up via
-            # state transfer from the new leader. The request carries the
-            # PRE-jump watermark (what we actually applied through).
-            self._applied_marker = old
-            self._state_request_mark = self.executed_through
-            self._send(self.primary, StateRequest(self.replica_id, old))
-
+        self._maybe_request_state(old, base)
 
     # -- state transfer (the BFT-SMaRt state-transfer role) ------------------
     _state_request_mark: int | None = None
     _applied_marker: int = -1
+    _state_votes: dict = None   # replaced with a fresh dict per request round
+    _st_ticks: int = 0
+
+    def _request_state(self) -> None:
+        """(Re)start a state-transfer round: reset the mark + vote tally and
+        ask EVERY other replica (≥2f+1 reachable in any view-change quorum)
+        for its state at our applied watermark."""
+        self._st_ticks = 0
+        self._state_request_mark = self.executed_through
+        self._state_votes = {}
+        for r in self.replicas:
+            if r != self.replica_id:
+                self._send(r, StateRequest(self.replica_id,
+                                           self._applied_marker))
+
+    def _maybe_request_state(self, old: int, base: int) -> None:
+        """If the watermark jump skipped sequences outside the certificate
+        window, requests executed elsewhere that no re-proposal carries are
+        missing locally — catch up via cross-validated state transfer.
+        The request goes to EVERY other replica (≥2f+1 reachable in any
+        view-change quorum) and a snapshot is only installed once f+1
+        distinct replicas return byte-identical state (PBFT §4.6 /
+        BFT-SMaRt state transfer): one Byzantine responder — including a
+        Byzantine new primary — cannot install fabricated notary state."""
+        if old >= base - 1 - self.cert_retention:
+            return
+        if self.restore_fn is None:
+            log.warning(
+                "%s: watermark jump %d -> %d skipped sequences beyond the "
+                "certificate window but no restore_fn is configured — the "
+                "local state machine is missing commits and cannot catch up",
+                self.replica_id, old, base - 1)
+            return
+        self._applied_marker = old
+        self._request_state()
 
     def _on_state_request(self, m: StateRequest) -> None:
         if self.snapshot_fn is None or self.executed_through <= m.through:
             return
         self._send(m.replica, StateResponse(
-            self.snapshot_fn(), self.executed_through,
+            self.replica_id, self.snapshot_fn(), self.executed_through,
             tuple(sorted(self._executed_requests))))
 
-    def _on_state_response(self, m: StateResponse) -> None:
+    def _on_state_response(self, m: StateResponse,
+                           sender: str | None = None) -> None:
         if self.restore_fn is None or self._state_request_mark is None:
             return
-        if self.executed_through != self._state_request_mark:
-            # we applied new commits since asking: that snapshot may miss
-            # them — ask again (the applied marker still lower-bounds what
-            # we could be missing)
-            self._state_request_mark = self.executed_through
-            self._send(self.primary,
-                       StateRequest(self.replica_id, self._applied_marker))
+        # the vote identity is the TRANSPORT-authenticated sender (mTLS cert
+        # CN / in-memory bus name) — the payload's self-declared replica
+        # field alone would let one Byzantine peer cast all f+1 votes. A
+        # payload that disagrees with its transport identity is discarded.
+        voter = sender if sender is not None else m.replica
+        if m.replica != voter or voter == self.replica_id \
+                or voter not in self.replicas:
             return
-        if m.through >= self.executed_through:
-            self.restore_fn(m.snapshot)
-            self._executed_requests.update(m.executed_ids)
-            self.executed_through = max(self.executed_through, m.through)
-            for rid in m.executed_ids:
-                self._pending.pop(rid, None)
-            self._state_request_mark = None
+        if self.executed_through != self._state_request_mark:
+            # we applied new commits since asking: those snapshots may miss
+            # them — ask everyone again (the applied marker still
+            # lower-bounds what we could be missing)
+            self._request_state()
+            return
+        if m.through < self.executed_through:
+            return
+        # tally byte-identical responses; install only at f+1 agreement
+        key = hashlib.sha256(
+            serialize([m.snapshot, m.through, m.executed_ids])).digest()
+        votes = self._state_votes if self._state_votes is not None else {}
+        self._state_votes = votes
+        votes.setdefault(key, set()).add(voter)
+        if len(votes[key]) < self.f + 1:
+            return
+        self.restore_fn(m.snapshot)
+        self._executed_requests.update(m.executed_ids)
+        self.executed_through = max(self.executed_through, m.through)
+        for rid in m.executed_ids:
+            self._pending.pop(rid, None)
+        self._state_request_mark = None
+        self._state_votes = {}
+        self._st_ticks = 0
 
 
 class BFTClient:

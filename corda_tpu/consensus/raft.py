@@ -343,19 +343,35 @@ class RaftNode:
         self.role = FOLLOWER
         self.leader_id = m.leader
         self._election_deadline = self._new_election_timeout()
-        # consistency check at prev_log_index
-        if m.prev_log_index > self.state.last_index() or \
-                self.state.term_at(m.prev_log_index) != m.prev_log_term:
+        # consistency check at prev_log_index (negative values never come
+        # from a correct leader and would index the log from the end)
+        if m.prev_log_index < 0 or m.prev_log_index > self.state.last_index() \
+                or self.state.term_at(m.prev_log_index) != m.prev_log_term:
             self._post(m.leader, AppendResponse(self.state.current_term,
                                                 self.node_id, False, 0))
             return
-        # append / overwrite conflicting suffix
-        if m.entries or self.state.last_index() > m.prev_log_index:
-            self.state.log = self.state.log[:m.prev_log_index] + list(m.entries)
-            self._persist_suffix(m.prev_log_index + 1)
+        # Raft §5.3: truncate only from the first term-conflicting entry —
+        # a stale/duplicated append whose entries match the existing suffix
+        # must not discard later entries already replicated past it
+        idx = m.prev_log_index + 1
+        keep = 0
+        for keep, entry in enumerate(m.entries):
+            if idx + keep > self.state.last_index() or \
+                    self.state.term_at(idx + keep) != entry.term:
+                break
+        else:
+            keep = len(m.entries)
+        if keep < len(m.entries):
+            self.state.log = (self.state.log[:idx + keep - 1]
+                              + list(m.entries[keep:]))
+            self._persist_suffix(idx + keep)
         if m.leader_commit > self.state.commit_index:
-            self.state.commit_index = min(m.leader_commit,
-                                          self.state.last_index())
+            # Raft: clamp to the last entry THIS append covered, not the
+            # whole local log — with conflict-only truncation an uncommitted
+            # divergent suffix may extend past prev+len(entries), and a
+            # stale/forged append must not commit it
+            self.state.commit_index = min(
+                m.leader_commit, m.prev_log_index + len(m.entries))
         self._apply_committed()
         self._post(m.leader, AppendResponse(
             self.state.current_term, self.node_id, True,
@@ -366,8 +382,13 @@ class RaftNode:
         if self.role != LEADER or m.term != self.state.current_term:
             return
         if m.success:
-            self._match_index[m.follower] = m.match_index
-            self._next_index[m.follower] = m.match_index + 1
+            # clamp: a forged/corrupt response with a huge match_index would
+            # drive next_index past the log end and _send_append's term_at
+            # out of range — same hostile-input posture as the prev_log_index
+            # check in _on_append
+            match = min(max(m.match_index, 0), self.state.last_index())
+            self._match_index[m.follower] = match
+            self._next_index[m.follower] = match + 1
             self._maybe_commit()
         else:
             self._next_index[m.follower] = max(

@@ -34,8 +34,21 @@ class SandboxViolation(Exception):
     """Contract source uses a construct outside the deterministic whitelist."""
 
 
-class SandboxCostExceeded(Exception):
-    """Contract execution exhausted its instruction budget."""
+class SandboxCostExceeded(BaseException):
+    """The in-flight budget kill raised inside sandboxed frames.
+
+    Derives from BaseException (not Exception) so sandboxed ``except
+    Exception`` handlers cannot swallow it — the budget kill must always
+    propagate out of the contract, mirroring the reference's
+    ThreadDeath-style TerminateException which user code cannot catch.
+    At the sandbox boundary (``load``/``run``) it is rewrapped into
+    :class:`SandboxBudgetError` so HOST code keeps ordinary
+    ``except Exception`` semantics (a budget-killed contract becomes a
+    normal verification failure, not a worker-killing BaseException)."""
+
+
+class SandboxBudgetError(Exception):
+    """Host-facing form of a budget kill, raised by ``load``/``run``."""
 
 
 _SAFE_BUILTIN_NAMES = (
@@ -81,10 +94,45 @@ def validate(source: str) -> ast.Module:
             raise SandboxViolation(
                 f"line {node.lineno}: access to underscore attribute "
                 f"{node.attr!r} is not allowed")
-        if isinstance(node, ast.Name) and node.id.startswith("__"):
+        # ANY underscore-prefixed name is banned (not just dunders): the
+        # cost-accounting hooks are injected under single-underscore names
+        # after validation, so user source must never be able to name (and
+        # thus rebind or shadow) them.
+        if isinstance(node, ast.Name) and node.id.startswith("_"):
             raise SandboxViolation(
-                f"line {node.lineno}: dunder name {node.id!r} is not allowed")
+                f"line {node.lineno}: underscore name {node.id!r} "
+                f"is not allowed")
+        if isinstance(node, (ast.FunctionDef, ast.ClassDef)) and \
+                node.name.startswith("_"):
+            raise SandboxViolation(
+                f"line {node.lineno}: underscore name {node.name!r} "
+                f"is not allowed")
+        if isinstance(node, ast.arg) and node.arg.startswith("_"):
+            raise SandboxViolation(
+                f"line {node.lineno}: underscore argument {node.arg!r} "
+                f"is not allowed")
+        if isinstance(node, ast.keyword) and node.arg and \
+                node.arg.startswith("_"):
+            raise SandboxViolation(
+                f"line {node.lineno}: underscore keyword {node.arg!r} "
+                f"is not allowed")
+        # bare `except:` catches BaseException and could swallow the budget
+        # kill; require an explicit (whitelisted, Exception-derived) type.
+        if isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                raise SandboxViolation(
+                    f"line {node.lineno}: bare except is not allowed")
+            if node.name and node.name.startswith("_"):
+                raise SandboxViolation(
+                    f"line {node.lineno}: underscore name {node.name!r} "
+                    f"is not allowed")
     return tree
+
+
+def _as_load(target: ast.expr) -> ast.expr:
+    """Deep-copy a Store-context assignment target as a Load expression."""
+    copied = ast.parse(ast.unparse(target), mode="eval").body
+    return copied
 
 
 class _CostTransformer(ast.NodeTransformer):
@@ -95,6 +143,23 @@ class _CostTransformer(ast.NodeTransformer):
 
     CHARGE = "_sandbox_charge"
     ITER = "_sandbox_iter"
+    BINOP = "_sandbox_binop"
+
+    # operators whose single-statement cost can be unbounded (10**10**8,
+    # 'a' * 10**9, 1 << 10**9, repeated s = s + s doubling): routed through
+    # a guarded helper that prices the result size against the budget
+    # before evaluating.
+    _GUARDED_OPS = {ast.Pow: "**", ast.Mult: "*", ast.LShift: "<<",
+                    ast.Add: "+"}
+
+    def visit_BinOp(self, node):
+        node = self.generic_visit(node)
+        label = self._GUARDED_OPS.get(type(node.op))
+        if label is None:
+            return node
+        return ast.copy_location(ast.Call(
+            ast.Name(self.BINOP, ast.Load()),
+            [ast.Constant(label), node.left, node.right], []), node)
 
     def _charge_stmt(self, at) -> ast.Expr:
         return ast.copy_location(ast.Expr(ast.Call(
@@ -113,8 +178,31 @@ class _CostTransformer(ast.NodeTransformer):
         return node
 
     def visit_FunctionDef(self, node):
+        # default-argument and decorator expressions execute at def time —
+        # they need the binop guards too, not just the body
+        node.args = self.generic_visit(node.args)
+        node.decorator_list = [self.visit(d) for d in node.decorator_list]
         node.body = self._rewrite_body(node.body)
         return node
+
+    def visit_AugAssign(self, node):
+        # `x **= y` etc. must route through the same guard: desugar to
+        # `x = _sandbox_binop("**=", x, y)`. The "=" suffix makes the
+        # helper use the IN-PLACE operator (operator.ipow/imul/...), so
+        # `b += [2]` still mutates an aliased list exactly as Python does
+        # (re-evaluating a subscript/attribute target is acceptable inside
+        # the deterministic whitelist).
+        if type(node.op) not in self._GUARDED_OPS:
+            return self.generic_visit(node)
+        label = self._GUARDED_OPS[type(node.op)] + "="
+        load_target = ast.copy_location(ast.fix_missing_locations(
+            _as_load(node.target)), node.target)
+        call = ast.copy_location(ast.Call(
+            ast.Name(self.BINOP, ast.Load()),
+            [ast.Constant(label), load_target, self.visit(node.value)], []),
+            node)
+        return ast.copy_location(
+            ast.Assign(targets=[node.target], value=call), node)
 
     def visit_For(self, node):
         node.iter = ast.copy_location(ast.Call(
@@ -161,8 +249,8 @@ class DeterministicSandbox:
         code = compile(tree, "<sandboxed-contract>", "exec")
         self._spent = 0
 
-        def charge():
-            self._spent += 1
+        def charge(units: int = 1):
+            self._spent += units
             if self._spent > self.instruction_budget:
                 raise SandboxCostExceeded(
                     f"instruction budget {self.instruction_budget} exhausted")
@@ -172,6 +260,99 @@ class DeterministicSandbox:
                 charge()
                 yield item
 
+        def _size_units(v) -> int:
+            """Price an operand: ints by bit length, sized containers by
+            length, everything else flat."""
+            if isinstance(v, bool):
+                return 1
+            if isinstance(v, int):
+                return max(1, v.bit_length() // 64)
+            try:
+                return max(1, len(v) // 64)
+            except TypeError:
+                return 1
+
+        def guarded_binop(op: str, left, right):
+            """Evaluate **, *, << or + with the result size pre-charged, so
+            a single statement cannot smuggle unbounded work past the
+            per-statement accounting (ADVICE r1: `x = 10**10**8`). An "="
+            suffix selects the in-place operator, preserving aliased-mutable
+            semantics for augmented assignments (`b += [2]`)."""
+            import operator as _op
+            inplace = op.endswith("=")
+            base_op = op[:-1] if inplace else op
+            if base_op == "**":
+                # |base| <= 1 powers are O(1) no matter the exponent
+                if isinstance(left, int) and isinstance(right, int) \
+                        and not isinstance(left, bool) \
+                        and right > 0 and abs(left) > 1:
+                    charge(max(1, (abs(left).bit_length() * right) // 64))
+                apply = _op.ipow if inplace else _op.pow
+            elif base_op == "<<":
+                if isinstance(left, int) and isinstance(right, int) \
+                        and right > 0 and left != 0:
+                    charge(max(1, (abs(left).bit_length() + right) // 64))
+                apply = _op.ilshift if inplace else _op.lshift
+            elif base_op == "+":
+                # sequence concatenation priced by combined length, so
+                # `s = s + s` doubling charges exponentially alongside the
+                # data and hits the budget long before memory; numeric adds
+                # charge their flat statement cost only
+                if not isinstance(left, (int, float, complex)):
+                    charge(_size_units(left) + _size_units(right))
+                apply = _op.iadd if inplace else _op.add
+            else:  # '*': sequences replicate, big ints multiply
+                if isinstance(right, int) and not isinstance(right, bool):
+                    try:
+                        n = len(left)
+                    except TypeError:
+                        n = None
+                    if n is not None and right > 0:
+                        charge(max(1, (n * right) // 64))
+                if isinstance(left, int) and not isinstance(left, bool):
+                    try:
+                        n = len(right)
+                    except TypeError:
+                        n = None
+                    if n is not None and left > 0:
+                        charge(max(1, (n * left) // 64))
+                if isinstance(left, int) and isinstance(right, int):
+                    charge(max(1,
+                               (_size_units(left) + _size_units(right)) // 2))
+                apply = _op.imul if inplace else _op.mul
+            return apply(left, right)
+
+        def guarded_pow(base, exp, mod=None):
+            if mod is not None:
+                charge(_size_units(base) + _size_units(exp) +
+                       _size_units(mod))
+                return pow(base, exp, mod)
+            return guarded_binop("**", base, exp)
+
+        def guarded_range(*args):
+            r = range(*args)
+            # length computed arithmetically: len() overflows past maxsize
+            start, stop, step = r.start, r.stop, r.step
+            if step > 0:
+                n = max(0, (stop - start + step - 1) // step)
+            else:
+                n = max(0, (start - stop - step - 1) // -step)
+            if n > self.instruction_budget:
+                raise SandboxCostExceeded(
+                    f"range of {n} exceeds instruction budget "
+                    f"{self.instruction_budget}")
+            # charge proportionally up front: consumers that bypass
+            # charged_iter (list(range(n)), sum(range(n))) must not get
+            # budget-squared free work out of repeated in-budget ranges
+            charge(max(1, n // 64))
+            return r
+
+        def guarded_bytes(*args):
+            if args and isinstance(args[0], int) \
+                    and not isinstance(args[0], bool):
+                charge(max(1, args[0] // 64))
+            return bytes(*args)
+
         def _builtin(name):
             return (__builtins__[name] if isinstance(__builtins__, dict)
                     else getattr(__builtins__, name))
@@ -179,14 +360,22 @@ class DeterministicSandbox:
         safe_builtins = {name: _builtin(name) for name in _SAFE_BUILTIN_NAMES}
         # class-statement machinery (builds only already-validated code)
         safe_builtins["__build_class__"] = _builtin("__build_class__")
+        # cost-capped replacements for the unbounded-in-one-call builtins
+        safe_builtins["pow"] = guarded_pow
+        safe_builtins["range"] = guarded_range
+        safe_builtins["bytes"] = guarded_bytes
         namespace = {
             "__builtins__": safe_builtins,
             "__name__": "sandboxed_contract",
             _CostTransformer.CHARGE: charge,
             _CostTransformer.ITER: charged_iter,
+            _CostTransformer.BINOP: guarded_binop,
         }
         namespace.update(bindings or {})
-        exec(code, namespace)
+        try:
+            exec(code, namespace)
+        except SandboxCostExceeded as e:
+            raise SandboxBudgetError(str(e)) from None
         return namespace
 
     @property
@@ -194,5 +383,13 @@ class DeterministicSandbox:
         return getattr(self, "_spent", 0)
 
     def run(self, fn, *args, **kwargs):
-        """Call a function loaded by this sandbox (charging continues)."""
-        return fn(*args, **kwargs)
+        """Call a function loaded by this sandbox (charging continues).
+
+        This is the HOST boundary: a budget kill (BaseException inside the
+        sandbox, uncatchable there) surfaces as :class:`SandboxBudgetError`
+        (a plain Exception) so verifier/flow error paths handle it like any
+        contract failure. Always call sandboxed functions through here."""
+        try:
+            return fn(*args, **kwargs)
+        except SandboxCostExceeded as e:
+            raise SandboxBudgetError(str(e)) from None

@@ -89,6 +89,14 @@ class TcpMessagingService(MessagingService):
 
     async def _handle_connection(self, reader: asyncio.StreamReader,
                                  writer: asyncio.StreamWriter) -> None:
+        # under mTLS the authenticated identity is the peer certificate's CN
+        # — it overrides whatever sender the frame body claims, so consumers
+        # of Message.sender (e.g. BFT state-transfer vote tallies) see a
+        # transport-authenticated name, not an attacker-chosen string
+        cert_cn = None
+        if self.tls is not None:
+            from .tls import peer_common_name
+            cert_cn = peer_common_name(writer.get_extra_info("ssl_object"))
         try:
             while True:
                 header = await reader.readexactly(4)
@@ -98,7 +106,8 @@ class TcpMessagingService(MessagingService):
                 body = await reader.readexactly(length)
                 topic, session_id, sender, payload = deserialize(body)
                 msg = Message(TopicSession(topic, session_id), payload,
-                              sender=sender)
+                              sender=cert_cn if cert_cn is not None
+                              else sender)
                 self.executor.execute(lambda m=msg: self._deliver(m))
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass

@@ -108,12 +108,22 @@ class DemoBench:
         from ..testing.driver import await_node_ready
         web_ports = {n["name"]: n.get("web_port")
                      for n in self.spec.get("nodes", [])}
+        # in TLS mode the web gateway's RPC client must speak mTLS to the
+        # node plane too, using the same dev CA the configs were cut from
+        ca_dir = (os.path.join(
+            self.spec.get("base_directory", "demo-network"), "dev-ca")
+            if self.spec.get("tls") else None)
         for path in generate_node_configs(self.spec):
             with open(path) as f:
                 name = json.load(f)["my_legal_name"]
             env = dict(os.environ)
-            env.setdefault("PYTHONPATH", os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__)))))
+            # PREPEND the repo root: an inherited PYTHONPATH (e.g. a
+            # platform site dir) must not keep child nodes from importing
+            # this package when launched outside the repo cwd
+            repo_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            env["PYTHONPATH"] = (repo_root + os.pathsep + env["PYTHONPATH"]
+                                 if env.get("PYTHONPATH") else repo_root)
             proc = subprocess.Popen(
                 [sys.executable, "-m", "corda_tpu.node", "--config", path,
                  "--quiet"],
@@ -125,7 +135,8 @@ class DemoBench:
                 from ..client.rpc import CordaRPCClient
                 from .webserver import NodeWebServer
                 running.webserver = NodeWebServer(
-                    CordaRPCClient(host, port), port=int(web_ports[name])
+                    CordaRPCClient(host, port, tls_ca_directory=ca_dir),
+                    port=int(web_ports[name])
                 ).start()
                 running.web_port = running.webserver.port
             self.nodes.append(running)

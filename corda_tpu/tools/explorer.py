@@ -100,10 +100,13 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--watch", action="store_true")
     parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--tls-ca", default=None,
+                        help="dev-CA directory for an mTLS node plane")
     args = parser.parse_args(argv)
     from ..client.rpc import CordaRPCClient
     import corda_tpu.finance  # noqa: F401 — wire types for deserialization
-    explorer = Explorer(CordaRPCClient(args.host, args.port))
+    explorer = Explorer(CordaRPCClient(args.host, args.port,
+                                       tls_ca_directory=args.tls_ca))
     if args.watch:
         try:
             explorer.watch(args.interval)

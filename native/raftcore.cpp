@@ -285,7 +285,11 @@ struct Core {
     role = FOLLOWER;
     leader = from_leader;
     reset_election_deadline();
-    bool fail = prev_idx > last_index() || term_at(prev_idx) != prev_term;
+    // prev_idx < 0 never occurs from a correct leader; without the check a
+    // hostile/malformed AppendEntries passes `prev_idx > last_index()` and
+    // term_at() indexes the log out of bounds (ADVICE r1).
+    bool fail = prev_idx < 0 || prev_idx > last_index() ||
+                term_at(prev_idx) != prev_term;
     std::vector<Entry> entries;
     if (!fail) fail = !unpack_entries(packed, packed_len, &entries);
     if (fail) {
@@ -297,10 +301,26 @@ struct Core {
       emit(std::move(a));
       return;
     }
-    log.resize(static_cast<size_t>(prev_idx));
-    for (auto& e : entries) log.push_back(std::move(e));
+    // Raft §5.3: truncate only from the first entry whose term conflicts
+    // with the incoming one — a stale or duplicated append whose entries
+    // all match the existing suffix must not discard later entries the
+    // leader has already replicated past.
+    size_t i = 0;
+    int64_t idx = prev_idx + 1;
+    for (; i < entries.size() && idx <= last_index(); i++, idx++) {
+      if (term_at(idx) != entries[i].term) {
+        log.resize(static_cast<size_t>(idx) - 1);
+        break;
+      }
+    }
+    for (; i < entries.size(); i++) log.push_back(std::move(entries[i]));
     if (leader_commit > commit_index) {
-      commit_index = std::min(leader_commit, last_index());
+      // Raft: clamp to the last entry THIS append covered — with
+      // conflict-only truncation an uncommitted divergent suffix may
+      // extend past prev_idx + entries, and a stale/forged append must
+      // not commit it
+      int64_t covered = prev_idx + static_cast<int64_t>(entries.size());
+      commit_index = std::min(leader_commit, covered);
     }
     apply_committed();
     Action a;
@@ -317,6 +337,11 @@ struct Core {
     observe_term(term);
     if (role != LEADER || term != current_term) return;
     if (success) {
+      // clamp: a forged/corrupt response with a huge match would drive
+      // next_index past the log end and send_append's term_at(prev) out of
+      // bounds — same hostile-input posture as on_append's prev_idx check
+      if (match > last_index()) match = last_index();
+      if (match < 0) match = 0;
       match_index[follower] = match;
       next_index[follower] = match + 1;
       maybe_commit();

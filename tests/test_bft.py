@@ -167,6 +167,58 @@ def test_state_transfer_beyond_cert_window():
     assert all(r.view >= 1 for r in live)
 
 
+def test_state_transfer_rejects_single_byzantine_response():
+    """ADVICE r1: a snapshot must only be installed once f+1 distinct
+    replicas return byte-identical state — a lone Byzantine responder (even
+    the new primary) cannot install fabricated notary state."""
+    from corda_tpu.consensus.bft import StateResponse
+    from corda_tpu.core.serialization import serialize as ser
+
+    bus = InMemoryMessagingNetwork()
+    names = [f"bft{i}" for i in range(4)]
+    machines = [DistributedImmutableMap() for _ in range(4)]
+    replicas = [BFTReplica(name, names, bus.create_node(name),
+                           machines[i].apply,
+                           snapshot_fn=machines[i].snapshot,
+                           restore_fn=machines[i].restore,
+                           cert_retention=2)
+                for i, name in enumerate(names)]
+    lagger = replicas[3]
+    # put the lagger into a waiting-for-state posture
+    lagger._maybe_request_state(old=-1, base=10)
+    assert lagger._state_request_mark is not None
+    lagger.executed_through = lagger._state_request_mark
+
+    evil = DistributedImmutableMap()
+    evil.apply(commit_entry(b"forged", [ref(42)]))
+    forged = StateResponse("bft1", evil.snapshot(), 50, (999,))
+    lagger._handle(forged)
+    # one response (≤ f) installs nothing
+    assert len(machines[3]) == 0 and lagger._state_request_mark is not None
+
+    # a Byzantine peer cannot cast extra votes under other replicas' names:
+    # the payload's replica field must match the TRANSPORT-authenticated
+    # sender, so bft1 re-sending the same snapshot as "bft0"/"bft2" is
+    # discarded rather than tallied
+    lagger._handle(StateResponse("bft0", evil.snapshot(), 50, (999,)),
+                   sender="bft1")
+    lagger._handle(StateResponse("bft2", evil.snapshot(), 50, (999,)),
+                   sender="bft1")
+    assert len(machines[3]) == 0 and lagger._state_request_mark is not None
+
+    # a second, HONEST-but-different response still doesn't reach f+1 on
+    # either snapshot — no quorum, no install
+    honest = DistributedImmutableMap()
+    honest.apply(commit_entry(b"real", [ref(7)]))
+    lagger._handle(StateResponse("bft2", honest.snapshot(), 50, (1000,)))
+    assert len(machines[3]) == 0 and lagger._state_request_mark is not None
+
+    # f+1 = 2 byte-identical responses from distinct replicas install
+    lagger._handle(StateResponse("bft0", honest.snapshot(), 50, (1000,)))
+    assert len(machines[3]) == 1 and ref(7) in machines[3]._map
+    assert lagger._state_request_mark is None
+
+
 def test_bft_uniqueness_provider():
     import threading
     bus, replicas, machines, client = make_cluster()

@@ -99,6 +99,59 @@ def test_reelection_after_leader_death():
     assert all(a == ["pre-crash", "post-crash"] for a in surviving_logs)
 
 
+def test_duplicate_append_does_not_truncate_matching_suffix():
+    """Raft §5.3 (review r2): a stale/duplicated AppendEntries whose entries
+    all match the existing prefix must not discard later entries."""
+    from corda_tpu.consensus.raft import AppendEntries, LogEntry
+
+    bus, nodes = make_cluster(3)
+    follower = nodes[0]
+    follower.state.current_term = 2
+    follower.state.log = [LogEntry(1, "a"), LogEntry(1, "b"), LogEntry(2, "c")]
+    # duplicate of the first append (entry "a" only), as if delayed in flight
+    follower._on_append(AppendEntries(2, "raft1", 0, 0,
+                                      (LogEntry(1, "a"),), 0))
+    assert [e.entry for e in follower.state.log] == ["a", "b", "c"]
+
+
+def test_forged_empty_append_cannot_commit_divergent_suffix():
+    """Review r2: leader_commit must clamp to prev + len(entries) — an
+    empty append with a huge leader_commit must not apply an uncommitted
+    divergent local suffix to the state machine."""
+    from corda_tpu.consensus.raft import AppendEntries, LogEntry
+
+    applied = [[], [], []]
+    bus, nodes = make_cluster(3, applied=applied)
+    follower = nodes[0]
+    follower.state.current_term = 3
+    # committed prefix (applied) + divergent uncommitted suffix
+    follower.state.log = [LogEntry(1, "ok1"), LogEntry(2, "DIVERGENT")]
+    follower.state.commit_index = 1
+    follower._on_append(AppendEntries(3, "raft1", 1, 1, (), 2))
+    assert follower.state.commit_index == 1          # clamped to prev+0
+    assert "DIVERGENT" not in applied[0]
+    # a real append covering the suffix still commits it
+    follower._on_append(AppendEntries(3, "raft1", 1, 1,
+                                      (LogEntry(3, "ok2"),), 2))
+    assert follower.state.commit_index == 2
+    assert applied[0] and applied[0][-1] == "ok2"
+
+
+def test_append_response_match_index_clamped():
+    """Review r2: a forged AppendResponse with a huge match_index must not
+    drive next_index past the log end (out-of-range term_at on the next
+    heartbeat)."""
+    from corda_tpu.consensus.raft import AppendResponse, LEADER
+
+    bus, nodes = make_cluster(3)
+    leader = run_until_leader(bus, nodes)
+    peer = [n for n in nodes if n is not leader][0].node_id
+    leader._on_append_response(
+        AppendResponse(leader.state.current_term, peer, True, 10 ** 9))
+    assert leader._next_index[peer] == leader.state.last_index() + 1
+    leader._send_append(peer)   # must not raise
+
+
 def test_raft_uniqueness_provider_conflicts():
     bus = InMemoryMessagingNetwork()
     names = [f"raft{i}" for i in range(3)]

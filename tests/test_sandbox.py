@@ -7,7 +7,7 @@ thresholds (runaway code terminates deterministically).
 import pytest
 
 from corda_tpu.core.contracts.sandbox import (DeterministicSandbox,
-                                              SandboxCostExceeded,
+                                              SandboxBudgetError,
                                               SandboxViolation, validate)
 
 CONTRACT = """
@@ -58,13 +58,13 @@ def test_unsafe_builtins_absent():
 
 def test_runaway_loop_hits_budget():
     sandbox = DeterministicSandbox(instruction_budget=1000)
-    with pytest.raises(SandboxCostExceeded):
+    with pytest.raises(SandboxBudgetError):
         sandbox.load("while True:\n    x = 1\n")
 
 
 def test_iteration_is_charged():
     src = "total = sum(i for i in range(10_000))"
-    with pytest.raises(SandboxCostExceeded):
+    with pytest.raises(SandboxBudgetError):
         DeterministicSandbox(instruction_budget=100).load(src)
     ns = DeterministicSandbox(instruction_budget=100_000).load(src)
     assert ns["total"] == sum(range(10_000))
@@ -75,9 +75,115 @@ def test_budget_spans_later_calls():
     load — the budget covers the contract's whole lifetime."""
     sandbox = DeterministicSandbox(instruction_budget=5_000)
     ns = sandbox.load("def burn(n):\n    for i in range(n):\n        x = i\n")
-    ns["burn"](100)
-    with pytest.raises(SandboxCostExceeded):
-        ns["burn"](100_000)
+    sandbox.run(ns["burn"], 100)
+    with pytest.raises(SandboxBudgetError):
+        sandbox.run(ns["burn"], 100_000)
+
+
+def test_hook_rebinding_rejected():
+    """ADVICE r1: single-underscore names (incl. the injected cost hooks)
+    must be unnameable from contract source."""
+    for src in ("_sandbox_charge = len", "_sandbox_iter = iter",
+                "_x = 1", "def _f():\n    pass",
+                "def f(_a):\n    pass"):
+        with pytest.raises(SandboxViolation, match="underscore"):
+            validate(src)
+
+
+def test_budget_kill_not_swallowed_by_except():
+    """ADVICE r1: `while True: try: ... except Exception: pass` must not
+    neutralize the budget — SandboxCostExceeded derives from BaseException."""
+    src = ("while True:\n"
+           "    try:\n"
+           "        x = 1\n"
+           "    except Exception:\n"
+           "        x = 2\n")
+    with pytest.raises(SandboxBudgetError):
+        DeterministicSandbox(instruction_budget=1000).load(src)
+
+
+def test_bare_except_rejected():
+    with pytest.raises(SandboxViolation, match="bare except"):
+        validate("try:\n    x = 1\nexcept:\n    pass\n")
+
+
+def test_single_statement_blowups_capped():
+    """ADVICE r1: one statement must not smuggle unbounded work past the
+    per-statement accounting."""
+    for src in ("x = 10 ** (10 ** 8)",
+                "x = 2 ** 100_000_000",
+                "x = 1 << 10 ** 9",
+                "x = 'a' * (10 ** 12)",
+                "x = pow(2, 10 ** 9)",
+                "x = list(range(10 ** 10))",
+                "y = 7\ny **= 10 ** 8",
+                "x = bytes(10 ** 10)",
+                # s = s + s doubling: '+' is priced by sequence size, so the
+                # budget dies exponentially alongside the data (no OOM race)
+                "s = 'a' * 1000\n" + "s = s + s\n" * 40,
+                # repeated in-budget ranges must still charge proportionally
+                "for i in range(100):\n    x = list(range(99_000))"):
+        with pytest.raises(SandboxBudgetError):
+            DeterministicSandbox(instruction_budget=100_000).load(src)
+
+
+def test_guarded_ops_still_correct():
+    ns = DeterministicSandbox().load(
+        "a = 3 ** 5\n"
+        "b = 'ab' * 3\n"
+        "c = pow(7, 11, 13)\n"
+        "d = 1 << 10\n"
+        "e = 6 * 7\n"
+        "f = 2\n"
+        "f **= 3\n"
+        "g = [0] * 4\n")
+    assert ns["a"] == 243 and ns["b"] == "ababab" and ns["c"] == pow(7, 11, 13)
+    assert ns["d"] == 1024 and ns["e"] == 42 and ns["f"] == 8
+    assert ns["g"] == [0, 0, 0, 0]
+
+
+def test_default_arg_blowup_guarded():
+    """Review r2: default-argument expressions execute at def time and must
+    route through the binop guards too."""
+    with pytest.raises(SandboxBudgetError):
+        DeterministicSandbox(instruction_budget=100_000).load(
+            "def f(x=10 ** (10 ** 8)):\n    return x\n")
+
+
+def test_augassign_preserves_aliasing():
+    """Review r2: `b += [2]` must mutate an aliased list in place, exactly
+    like Python — the guard uses the in-place operator."""
+    ns = DeterministicSandbox().load(
+        "a = [1]\nb = a\nb += [2]\nc = 'x'\nc += 'y'\n")
+    assert ns["a"] == [1, 2] and ns["b"] is ns["a"]
+    assert ns["c"] == "xy"
+
+
+def test_trivial_base_powers_stay_cheap():
+    """Review r2: |base| <= 1 powers are O(1); they must not charge by
+    exponent size."""
+    ns = DeterministicSandbox(instruction_budget=1000).load(
+        "a = 1 ** (10 ** 8)\nb = 0 ** (10 ** 8)\nc = (-1) ** (10 ** 8)\n")
+    assert ns["a"] == 1 and ns["b"] == 0 and ns["c"] == 1
+
+
+def test_except_handler_name_cannot_shadow_hooks():
+    with pytest.raises(SandboxViolation, match="underscore"):
+        validate("try:\n    x = 1\nexcept ValueError as _sandbox_charge:\n"
+                 "    x = 2\n")
+
+
+def test_budget_error_is_plain_exception_at_host_boundary():
+    """Review r2: the kill is a BaseException INSIDE the sandbox but a
+    plain Exception at load()/run(), so host `except Exception` error paths
+    treat it as an ordinary contract failure."""
+    sandbox = DeterministicSandbox(instruction_budget=100)
+    try:
+        sandbox.load("while True:\n    x = 1\n")
+    except Exception as e:
+        assert isinstance(e, SandboxBudgetError)
+    else:
+        raise AssertionError("budget kill did not surface")
 
 
 def test_bindings_visible():
