@@ -236,6 +236,163 @@ def mul(a, b, p: int):
     return _normalize(cols, nb, p)[0]
 
 
+# ---------------------------------------------------------------------------
+# Column-level fusion primitives (one normalize per *group* of products)
+#
+# The complete-addition formulas are full of `mul, mul, add/sub` triples that
+# each pay a full normalize walk. These primitives keep products as raw
+# column accumulators (value, exact bounds) so a whole linear combination
+# ± a·b ± c·d ± e normalizes ONCE. Negative terms are made borrow-free by
+# adding a multiple of p whose redundant limb encoding dominates their
+# column bounds (the wide generalization of the 32p trick in `sub`).
+# ---------------------------------------------------------------------------
+
+def rel(a, bounds=None):
+    """Wrap plain contract limbs as a (value, bounds) relaxed pair."""
+    return (a, _CONTRACT if bounds is None else bounds)
+
+
+def rel_add(ar, br):
+    """Relaxed add: no normalize; bounds sum. Inputs: (v, bounds) pairs or
+    plain arrays (contract bounds assumed)."""
+    a, ab = ar if isinstance(ar, tuple) else rel(ar)
+    b, bb = br if isinstance(br, tuple) else rel(br)
+    n = max(len(ab), len(bb))
+    ab = list(ab) + [0] * (n - len(ab))
+    bb = list(bb) + [0] * (n - len(bb))
+    if a.shape[-1] < n:
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, n - a.shape[-1])])
+    if b.shape[-1] < n:
+        b = jnp.pad(b, [(0, 0)] * (b.ndim - 1) + [(0, n - b.shape[-1])])
+    return (a + b, [x + y for x, y in zip(ab, bb)])
+
+
+def rel_sub(ar, br, p: int):
+    """Relaxed borrow-free subtract: a + OFFSET(p, dominating b) - b, NO
+    normalize. The result is wider/looser; feed it to `mul_cols` (which takes
+    exact bounds) or normalize explicitly via `norm`."""
+    a, ab = ar if isinstance(ar, tuple) else rel(ar)
+    b, bb = br if isinstance(br, tuple) else rel(br)
+    off, ob = _dominator_offset(tuple(bb), p)
+    n = max(len(ab), len(ob))
+    v = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]) + (n,),
+                  dtype=jnp.uint64)
+    v = v.at[..., :len(ab)].add(a)
+    v = v.at[..., :len(ob)].add(jnp.asarray(off))
+    v = v.at[..., :len(bb)].add(-b)   # u64 wrap-free: off dominates b
+    nb = [0] * n
+    for i, x in enumerate(ab):
+        nb[i] += x
+    for i, x in enumerate(ob):
+        nb[i] += x
+    return (v, nb)
+
+
+def norm(vr, p: int):
+    """Normalize a relaxed (value, bounds) pair to a contract element."""
+    v, nb = vr
+    return _normalize(v, list(nb), p)[0]
+
+
+def mul_cols(ar, br):
+    """Schoolbook product of relaxed pairs → raw (cols, bounds), NO
+    normalize. Accepts plain arrays (contract bounds) or (v, bounds)."""
+    a, ab = ar if isinstance(ar, tuple) else rel(ar)
+    b, bb = br if isinstance(br, tuple) else rel(br)
+    na, nbw = len(ab), len(bb)
+    cols = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+                     + (na + nbw - 1,), dtype=jnp.uint64)
+    for i in range(na):
+        cols = cols.at[..., i:i + nbw].add(a[..., i:i + 1] * b)
+    out = [0] * (na + nbw - 1)
+    for i, x in enumerate(ab):
+        for j, y in enumerate(bb):
+            out[i + j] += x * y
+    assert max(out) < (1 << 63), "u64 column overflow in fused schoolbook"
+    return (cols, out)
+
+
+def scale_rel(a, k: int, bounds=None):
+    """Small-constant scale of a narrow element WITHOUT normalizing: returns
+    a relaxed (value, bounds) pair for feeding rel_add/rel_sub/mul_cols."""
+    b = _CONTRACT if bounds is None else bounds
+    out = [x * k for x in b]
+    assert max(out) < (1 << 63)
+    return (a * jnp.uint64(k), out)
+
+
+def scale_cols(cr, k: int):
+    """Scale a raw (value, bounds) pair by a small host constant — folds a
+    mul_const into an adjacent normalize for free."""
+    v, nb = cr
+    out = [b * k for b in nb]
+    assert max(out) < (1 << 63), "u64 column overflow in scale_cols"
+    return (v * jnp.uint64(k), out)
+
+
+_DOM_OFFSETS: dict = {}
+
+
+def _dominator_offset(need: tuple, p: int):
+    """A redundant wide-limb encoding of M·p whose limb i dominates
+    ``need[i]`` — adding it makes subtracting any value bounded by ``need``
+    borrow-free while preserving the residue mod p. Cached per (p, need)
+    (bounds are trace-time static)."""
+    key = (p, tuple(need))
+    if key in _DOM_OFFSETS:
+        return _DOM_OFFSETS[key]
+    S = sum(int(b) << (LIMB_BITS * i) for i, b in enumerate(need))
+    M = (S // p) + 2
+    R = M * p - S
+    width = max(len(need), -(-R.bit_length() // LIMB_BITS))
+    digits = [int(b) for b in list(need) + [0] * (width - len(need))]
+    for i in range(width):
+        digits[i] += (R >> (LIMB_BITS * i)) & MASK
+    extra = R >> (LIMB_BITS * width)
+    if extra:
+        digits.append(int(extra))
+    assert sum(d << (LIMB_BITS * i) for i, d in enumerate(digits)) == M * p
+    assert all(d >= n for d, n in zip(digits, need))
+    out = (np.array(digits, dtype=np.uint64), digits)
+    _DOM_OFFSETS[key] = out
+    return out
+
+
+def col_acc(p: int, plus=(), minus=()):
+    """Accumulate raw column products: sum(plus) - sum(minus) + dominator,
+    returning a relaxed (value, bounds) pair (normalize with `norm`).
+    Each entry is a (cols, bounds) pair from `mul_cols` (or a relaxed pair
+    from rel/rel_add — any (value, exact bounds))."""
+    neg_nb: list = []
+    for _, nb in minus:
+        if len(nb) > len(neg_nb):
+            neg_nb += [0] * (len(nb) - len(neg_nb))
+        for i, x in enumerate(nb):
+            neg_nb[i] += x
+    if minus:
+        off, ob = _dominator_offset(tuple(neg_nb), p)
+    else:
+        off, ob = None, []
+    width = max([len(nb) for _, nb in plus] + [len(ob)]
+                + [len(nb) for _, nb in minus])
+    shapes = [v.shape[:-1] for v, _ in list(plus) + list(minus)]
+    out = jnp.zeros(jnp.broadcast_shapes(*shapes) + (width,),
+                    dtype=jnp.uint64)
+    nb_out = [0] * width
+    for v, nb in plus:
+        out = out.at[..., :v.shape[-1]].add(v)
+        for i, x in enumerate(nb):
+            nb_out[i] += x
+    if off is not None:
+        out = out.at[..., :len(ob)].add(jnp.asarray(off))
+        for i, x in enumerate(ob):
+            nb_out[i] += x
+        for v, _ in minus:
+            out = out.at[..., :v.shape[-1]].add(-v)
+    assert max(nb_out) < (1 << 63), "u64 column overflow in col_acc"
+    return (out, nb_out)
+
+
 def sqr(a, p: int):
     return mul(a, a, p)
 

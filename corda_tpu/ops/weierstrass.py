@@ -62,14 +62,53 @@ def _points_to_limbs(col):
     return (px, py, pz)
 
 
+def _add_k1(Pt, Qt, p: int, b3: int):
+    """Fused RCB complete addition for a = 0, small b3 (secp256k1).
+
+    Same mathematics as the a == 0 branch of :func:`add`, but products are
+    kept as raw column accumulators (F.mul_cols) and every linear
+    combination ±a·b ±c·d normalizes ONCE (F.col_acc + F.norm): ~10
+    normalize walks instead of ~22 for the same 12 schoolbook products —
+    the normalize walk is ~40% of a field mul, so this is the single
+    biggest per-add saving after the formula choice itself."""
+    X1, Y1, Z1 = Pt
+    X2, Y2, Z2 = Qt
+    c0 = F.mul_cols(X1, X2)
+    c1 = F.mul_cols(Y1, Y2)
+    c2 = F.mul_cols(Z1, Z2)
+    t1 = F.norm(c1, p)
+    t2 = F.norm(c2, p)
+    t0x3 = F.norm(F.scale_cols(c0, 3), p)              # 3·t0
+    t3 = F.norm(F.col_acc(p, plus=[F.mul_cols(F.rel_add(X1, Y1),
+                                              F.rel_add(X2, Y2))],
+                          minus=[c0, c1]), p)
+    t4b3 = F.norm(F.scale_cols(
+        F.col_acc(p, plus=[F.mul_cols(F.rel_add(X1, Z1),
+                                      F.rel_add(X2, Z2))],
+                  minus=[c0, c2]), b3), p)             # b3·t4
+    t5 = F.norm(F.col_acc(p, plus=[F.mul_cols(F.rel_add(Y1, Z1),
+                                              F.rel_add(Y2, Z2))],
+                          minus=[c1, c2]), p)
+    bt2 = F.mul_const(t2, b3, p)
+    Xm = F.rel_sub(t1, bt2, p)       # t1 - b3·t2, relaxed (no normalize)
+    Zm = F.rel_add(t1, bt2)          # t1 + b3·t2, relaxed
+    Y3 = F.norm(F.col_acc(p, plus=[F.mul_cols(Xm, Zm),
+                                   F.mul_cols(t0x3, t4b3)]), p)
+    X3 = F.norm(F.col_acc(p, plus=[F.mul_cols(t3, Xm)],
+                          minus=[F.mul_cols(t5, t4b3)]), p)
+    Z3 = F.norm(F.col_acc(p, plus=[F.mul_cols(t5, Zm),
+                                   F.mul_cols(t3, t0x3)]), p)
+    return (X3, Y3, Z3)
+
+
 def add(Pt, Qt, curve: WeierstrassCurve):
     """RCB16 complete projective addition, specialized at trace time.
 
     Three variants chosen by the curve constants (all complete):
     - ``a == 0`` (secp256k1): the three a·x products are identically zero and
       drop out (RCB16 Algorithm 7 shape); with b3 = 21 small, both b3·x
-      products are ``mul_const`` — 12 full field muls per point-add instead
-      of 17.
+      products are ``mul_const`` — 12 full field muls per point-add, fused
+      column-level in :func:`_add_k1`.
     - ``a ≡ -small`` (secp256r1, a = -3): a·x = -(|a|·x) via ``mul_const`` +
       subtraction — 12 full muls + cheap constant muls.
     - general a: Algorithm 1 verbatim.
@@ -80,6 +119,8 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     neg_a = p - a           # |a| when a is a small negative constant
     small = F.MUL_CONST_MAX
     b3_c = None if b3 < small else _const(b3, p)
+    if a == 0 and b3 < small:
+        return _add_k1(Pt, Qt, p, b3)
 
     def mul_b3(x):
         return F.mul_const(x, b3, p) if b3_c is None else F.mul(x, b3_c, p)
@@ -98,16 +139,7 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     t5 = F.mul_of_sums(Y1, Z1, Y2, Z2, p)
     X3 = F.add(t1, t2, p)
     t5 = F.sub(t5, X3, p)
-    if a == 0:
-        # Z3 = b3·t2 + a·t4 = b3·t2 ;  t1' = 3t0 + a·t2 = 3t0 ;
-        # t4' = b3·t4 + a·(t0 - a·t2) = b3·t4
-        Z3 = mul_b3(t2)
-        X3 = F.sub(t1, Z3, p)
-        Z3 = F.add(t1, Z3, p)
-        Y3 = F.mul(X3, Z3, p)
-        t1 = F.mul_const(t0, 3, p)
-        t4 = mul_b3(t4)
-    elif neg_a < small:
+    if neg_a < small:
         # a = -|a|:  Z3 = b3·t2 - |a|·t4 ;  t1' = 3t0 - |a|·t2 ;
         # t4' = b3·t4 + a·(t0 - a·t2) = b3·t4 - |a|·(t0 + |a|·t2)
         Z3 = F.sub(mul_b3(t2), F.mul_const(t4, neg_a, p), p)
@@ -145,29 +177,35 @@ def add(Pt, Qt, curve: WeierstrassCurve):
 
 def dbl(Pt, curve: WeierstrassCurve):
     """Complete projective doubling. For a = 0 with small b3 (secp256k1):
-    RCB16 Algorithm 9 — 8 full field muls + 4 constant muls versus the 12+2
-    of the complete add (doubling chains like 8Y² collapse into single
-    ``mul_const`` normalizations). Complete for every input including the
-    identity (0:1:0). Other curves fall back to add(P, P), which is complete
-    and already specialized per curve constants."""
+    RCB16 Algorithm 9, column-fused — 7 schoolbook products and 7 normalize
+    walks versus the 12-product complete add (doubling chains like 8Y²
+    collapse into column scales folded into adjacent normalizes). Complete
+    for every input including the identity (0:1:0). Other curves fall back
+    to add(P, P), which is complete and already specialized per curve
+    constants.
+
+    Derivation from Algorithm 9 (s = Y², z2 = Z², w = b3·z2):
+      X3 = 2·(s - 3w)·X·Y
+      Y3 = (s - 3w)·(s + w) + 8·w·s
+      Z3 = 8·s·Y·Z
+    """
     p = curve.p
     a = curve.a % p
     b3 = 3 * curve.b % p
     if a != 0 or b3 >= F.MUL_CONST_MAX:
         return add(Pt, Pt, curve)
     X, Y, Z = Pt
-    t0 = F.mul(Y, Y, p)
-    Z3 = F.mul_const(t0, 8, p)
-    t1 = F.mul(Y, Z, p)
-    t2 = F.mul_const(F.mul(Z, Z, p), b3, p)
-    X3 = F.mul(t2, Z3, p)
-    Y3 = F.add(t0, t2, p)
-    Z3 = F.mul(t1, Z3, p)
-    t0 = F.sub(t0, F.mul_const(t2, 3, p), p)
-    Y3 = F.mul(t0, Y3, p)
-    Y3 = F.add(X3, Y3, p)
-    t1 = F.mul(X, Y, p)
-    X3 = F.mul_const(F.mul(t0, t1, p), 2, p)
+    cy = F.mul_cols(Y, Y)
+    s = F.norm(cy, p)                                   # Y²
+    w = F.norm(F.scale_cols(F.mul_cols(Z, Z), b3), p)   # b3·Z²
+    xy = F.norm(F.mul_cols(X, Y), p)
+    yz = F.norm(F.mul_cols(Y, Z), p)
+    sm3w = F.rel_sub(s, F.scale_rel(w, 3), p)           # s - 3w, relaxed
+    spw = F.rel_add(s, w)
+    Y3 = F.norm(F.col_acc(p, plus=[F.mul_cols(sm3w, spw),
+                                   F.scale_cols(F.mul_cols(w, s), 8)]), p)
+    X3 = F.norm(F.scale_cols(F.mul_cols(sm3w, xy), 2), p)
+    Z3 = F.norm(F.scale_cols(F.mul_cols(yz, s), 8), p)
     return (X3, Y3, Z3)
 
 
@@ -312,26 +350,30 @@ def prepare_batch_glv(items):
 
 _G_TABLES: dict[str, tuple] = {}
 
+GLV_WINDOWS = (GLV_BITS + 1) // 2   # 65 two-bit windows, MSB-first
 
-def _g_sign_table(curve: WeierstrassCurve):
-    """(16, NLIMB)-per-coordinate constant projective table indexed by
-    ``ba + 2·bb + 4·sa + 8·sb``: entry = ba·(sa ? -G : G) + bb·(sb ? -phi(G)
-    : phi(G)). Identity rows are (0 : 1 : 0); the rest have Z = 1. G and
-    phi(G) are curve constants, so the whole table is baked into the kernel
-    and per-item rows come from one cheap device gather."""
+
+def _g_window_table(curve: WeierstrassCurve):
+    """(64, NLIMB)-per-coordinate constant projective table indexed by
+    ``wa + 4·wb + 16·sa + 32·sb``: entry = wa·(sa ? -G : G) + wb·(sb ?
+    -phi(G) : phi(G)) for 2-bit window digits wa, wb ∈ [0, 4). Identity
+    rows are (0 : 1 : 0). G and phi(G) are curve constants, so the whole
+    table is baked into the kernel; per-item rows come from one gather."""
     if curve.name in _G_TABLES:
         return _G_TABLES[curve.name]
     p = curve.p
     phi_g = (SECP256K1_BETA * curve.g[0] % p, curve.g[1])
     xs, ys, zs = [], [], []
-    for idx in range(16):
-        ba, bb, sa, sb = idx & 1, (idx >> 1) & 1, (idx >> 2) & 1, (idx >> 3) & 1
+    for idx in range(64):
+        wa, wb = idx & 3, (idx >> 2) & 3
+        sa, sb = (idx >> 4) & 1, (idx >> 5) & 1
         pt = None
-        if ba:
-            pt = (curve.g[0], (p - curve.g[1]) % p) if sa else curve.g
-        if bb:
-            pg = (phi_g[0], (p - phi_g[1]) % p) if sb else phi_g
-            pt = curve.add(pt, pg)
+        ga = (curve.g[0], (p - curve.g[1]) % p) if sa else curve.g
+        gb = (phi_g[0], (p - phi_g[1]) % p) if sb else phi_g
+        for _ in range(wa):
+            pt = ga if pt is None else curve.add(pt, ga)
+        for _ in range(wb):
+            pt = gb if pt is None else curve.add(pt, gb)
         xs.append(0 if pt is None else pt[0])
         ys.append(1 if pt is None else pt[1])
         zs.append(0 if pt is None else 1)
@@ -343,45 +385,78 @@ def _g_sign_table(curve: WeierstrassCurve):
     return tab
 
 
-def hybrid_ladder(g_idx, bits_c, bits_d, Qc, Qd, curve: WeierstrassCurve):
-    """[|a|](±G) + [|b|](±phi G) + [c]Qc + [d]Qd over GLV_BITS iterations.
+def _q_window_table(Qc, Qd, curve: WeierstrassCurve):
+    """16-entry per-item table T[i + 4j] = [i]Qc + [j]Qd (i, j ∈ [0,4)):
+    2 doublings + 12 complete adds, one-time per batch."""
+    batch_shape = Qc[0].shape[:-1]
+    T = [identity(batch_shape)] * 16
+    T[1] = Qc
+    T[2] = dbl(Qc, curve)
+    T[3] = add(T[2], Qc, curve)
+    T[4] = Qd
+    T[8] = dbl(Qd, curve)
+    T[12] = add(T[8], Qd, curve)
+    for j in (4, 8, 12):
+        for i in (1, 2, 3):
+            T[i + j] = add(T[i], T[j], curve)
+    return T
 
-    The G-side addend is gathered from the 16-entry *constant* sign table
-    (per-item signs folded into the index host-side); the Q-side addend is
-    the usual 4-way batched select over {1, Qc, Qd, Qc+Qd}. Versus
-    ``glv_ladder`` this replaces the 15-select binary tree with one gather
-    + 3 selects, at the cost of one extra complete add per iteration; versus
-    the plain 256-bit ``shamir_ladder`` it halves iteration count."""
+
+def hybrid_ladder(g_idx, q_bits, Qc, Qd, curve: WeierstrassCurve):
+    """[|a|](±G) + [|b|](±phi G) + [c]Qc + [d]Qd over GLV_WINDOWS 2-bit
+    windows: per window, 2 doublings + ONE constant-table G add (64-entry
+    gather) + ONE Q add (16-entry per-item select tree) — 40 schoolbook
+    products per 2 scalar bits versus 64 for the 1-bit ladder this replaced
+    (measured faster despite the deeper select tree; the per-item Q window
+    table costs 2 dbl + 12 adds one-time).
+
+    ``g_idx``: (W, B) int32 into the 64-entry G window table.
+    ``q_bits``: (W, B, 4) window digit bit-planes (wc&1, wc>>1, wd&1, wd>>1).
+    """
     batch_shape = Qc[0].shape[:-1]
     Pid = identity(batch_shape)
-    Qcd = add(Qc, Qd, curve)
-    gtab = tuple(jnp.asarray(t) for t in _g_sign_table(curve))
+    table = _q_window_table(Qc, Qd, curve)
+    gtab = tuple(jnp.asarray(t) for t in _g_window_table(curve))
 
     def step(acc, ins):
-        gi, bc, bd = ins
-        acc = dbl(acc, curve)
+        gi, qb = ins
+        acc = dbl(dbl(acc, curve), curve)
         g_addend = tuple(t[gi] for t in gtab)
         acc = add(acc, g_addend, curve)
-        q_addend = _select4(bc + 2 * bd, (Pid, Qc, Qd, Qcd))
-        return add(acc, q_addend, curve), None
+        level = table
+        for j in range(4):                # fold by index bit j (LSB first)
+            b = qb[..., j].astype(jnp.bool_)
+            level = [tuple(F.select(b, hi_c, lo_c)
+                           for lo_c, hi_c in zip(lo, hi))
+                     for lo, hi in zip(level[0::2], level[1::2])]
+        return add(acc, level[0], curve), None
 
-    acc, _ = jax.lax.scan(step, Pid, (g_idx, bits_c.astype(jnp.uint64),
-                                      bits_d.astype(jnp.uint64)), unroll=2)
+    acc, _ = jax.lax.scan(step, Pid, (g_idx, q_bits), unroll=2)
     return acc
 
 
-def verify_core_hybrid(g_idx, bits_c, bits_d, Qc, Qd, r_cands):
+def verify_core_hybrid(g_idx, q_bits, Qc, Qd, r_cands):
     curve = CURVES["secp256k1"]
-    X, Y, Z = hybrid_ladder(g_idx, bits_c, bits_d, Qc, Qd, curve)
+    X, Y, Z = hybrid_ladder(g_idx, q_bits, Qc, Qd, curve)
     return _accept(X, Z, r_cands, curve.p)
 
 
 _verify_kernel_hybrid = jax.jit(verify_core_hybrid)
 
 
+def _bits_to_windows(bits: np.ndarray) -> np.ndarray:
+    """(GLV_BITS, B) MSB-first bit array → (GLV_WINDOWS, B) 2-bit digits,
+    MSB-first (a leading zero bit is prepended when GLV_BITS is odd)."""
+    if bits.shape[0] % 2:
+        bits = np.concatenate(
+            [np.zeros((1,) + bits.shape[1:], bits.dtype), bits])
+    return bits[0::2] * 2 + bits[1::2]
+
+
 def prepare_batch_hybrid(items):
     """Host prep for the hybrid kernel: GLV-decompose u1 (G legs: signs into
-    the gather index) and u2 (Q legs: signs folded into the points)."""
+    the gather index) and u2 (Q legs: signs folded into the points), then
+    split each scalar into 2-bit windows MSB-first."""
     curve = CURVES["secp256k1"]
     p = curve.p
     precheck, pubs, u1s, u2s, r0, r1 = _precheck_and_scalars(curve, items)
@@ -400,16 +475,17 @@ def prepare_batch_hybrid(items):
                 k, pt = -k, (pt[0], (p - pt[1]) % p)
             ks.append(k)
             kpts.append(pt)
-    bits_a = F.scalars_to_bits(abs_a, GLV_BITS)
-    bits_b = F.scalars_to_bits(abs_b, GLV_BITS)
-    g_idx = (bits_a + 2 * bits_b
-             + 4 * np.asarray(sa, dtype=np.uint32)[None, :]
-             + 8 * np.asarray(sb, dtype=np.uint32)[None, :]).astype(np.int32)
-
+    wa = _bits_to_windows(F.scalars_to_bits(abs_a, GLV_BITS))
+    wb = _bits_to_windows(F.scalars_to_bits(abs_b, GLV_BITS))
+    g_idx = (wa + 4 * wb
+             + 16 * np.asarray(sa, dtype=np.uint32)[None, :]
+             + 32 * np.asarray(sb, dtype=np.uint32)[None, :]).astype(np.int32)
+    wc = _bits_to_windows(F.scalars_to_bits(cs, GLV_BITS))
+    wd = _bits_to_windows(F.scalars_to_bits(ds, GLV_BITS))
+    q_bits = np.stack([wc & 1, wc >> 1, wd & 1, wd >> 1],
+                      axis=-1).astype(np.uint64)
     r_cands = jnp.asarray(np.stack([F.to_limbs(r0), F.to_limbs(r1)]))
-    return (jnp.asarray(g_idx),
-            jnp.asarray(F.scalars_to_bits(cs, GLV_BITS)),
-            jnp.asarray(F.scalars_to_bits(ds, GLV_BITS)),
+    return (jnp.asarray(g_idx), jnp.asarray(q_bits),
             _points_to_limbs(qc_pts), _points_to_limbs(qd_pts),
             r_cands, precheck)
 

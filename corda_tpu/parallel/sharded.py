@@ -86,15 +86,13 @@ def sharded_ecdsa_verify_hybrid(mesh: Mesh):
     fastest single-chip path (ops.weierstrass.verify_core_hybrid), scaled
     the same dp way.
 
-    Input layout (from ops.weierstrass.prepare_batch_hybrid): g_idx /
-    bits_c / bits_d (GLV_BITS, B); Qc/Qd 3×(B, 16); r_cands (2, B, 16).
+    Input layout (from ops.weierstrass.prepare_batch_hybrid): g_idx
+    (W, B) int32; q_bits (W, B, 4); Qc/Qd 3×(B, 16); r_cands (2, B, 16).
     """
-    bits_spec = P(None, AXIS)
-    pt_spec = P(AXIS, None)
     shmapped = jax.shard_map(
         wc_ops.verify_core_hybrid, mesh=mesh,
-        in_specs=(bits_spec, bits_spec, bits_spec, (pt_spec,) * 3,
-                  (pt_spec,) * 3, P(None, AXIS, None)),
+        in_specs=(P(None, AXIS), P(None, AXIS, None), (P(AXIS, None),) * 3,
+                  (P(AXIS, None),) * 3, P(None, AXIS, None)),
         out_specs=P(AXIS),
         check_vma=False)  # see sharded_ed25519_verify
     return jax.jit(shmapped)
