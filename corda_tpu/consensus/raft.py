@@ -373,9 +373,13 @@ class RaftNode:
             self.state.commit_index = min(
                 m.leader_commit, m.prev_log_index + len(m.entries))
         self._apply_committed()
+        # match index = last entry THIS append verified, not last_index():
+        # with conflict-only truncation the local log can extend past the
+        # verified entries, and last_index() would let a batching leader
+        # commit entries the follower does not hold (ADVICE r2)
         self._post(m.leader, AppendResponse(
             self.state.current_term, self.node_id, True,
-            self.state.last_index()))
+            m.prev_log_index + len(m.entries)))
 
     def _on_append_response(self, m: AppendResponse) -> None:
         self._observe_term(m.term)

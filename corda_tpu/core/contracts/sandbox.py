@@ -27,6 +27,7 @@ produces the same verdict on every node or dies the same way on every node.
 from __future__ import annotations
 
 import ast
+import re
 from dataclasses import dataclass
 
 
@@ -75,7 +76,67 @@ _BANNED_NODES = {
     ast.Set: "set display (hash-order nondeterminism)",
     ast.SetComp: "set comprehension (hash-order nondeterminism)",
     ast.With: "with",
+    # match-statement capture patterns (MatchAs.name / MatchStar.name /
+    # MatchMapping.rest) carry raw string binding names that the ast.Name
+    # underscore ban never sees — `match int:\n case _sandbox_charge: pass`
+    # would rebind the injected charge hook and neutralize the budget
+    # (ADVICE r2 high). Ban the whole statement, consistent with the
+    # minimal deterministic whitelist.
+    ast.Match: "match statement",
 }
+
+# String methods whose one-call output size is set by an integer width
+# argument (ADVICE r2 medium: 'a'.ljust(200_000_000) allocates 200 MB for
+# ~2 charged units), plus the .format/.format_map methods whose spec string
+# smuggles the same width ('{:>200000000}'.format(1)). Banned outright;
+# contract code uses the guarded format() builtin instead.
+_WIDTH_METHODS = frozenset({
+    "ljust", "rjust", "center", "zfill", "expandtabs",
+    "format", "format_map",
+})
+
+# Largest width a format spec / %-format may request: big enough for any
+# honest tabular output, far below an allocation attack.
+_MAX_FORMAT_WIDTH = 10_000
+_MAX_WIDTH_DIGITS = len(str(_MAX_FORMAT_WIDTH))
+
+# %-format conversion specs: width/precision groups only — literal digits in
+# the template text ("block 20260730: %d") are NOT padding and must not count.
+_PERCENT_SPEC = re.compile(
+    r"%(?:\([^)]*\))?[-+ #0]*(\*|\d+)?(?:\.(\*|\d+))?[hlL]*"
+    r"[diouxXeEfFgGcrsab%]")
+
+
+def _spec_width(spec: str) -> int:
+    """Total of the integer runs in a format()/f-string spec string — an
+    upper bound on the padding it can demand. Runs longer than the cap's
+    digit count are reported as over-cap WITHOUT calling int() (CPython's
+    int-to-str digit limit raises ValueError past 4300 digits, and that
+    limit is per-process configurable — a determinism hazard)."""
+    total = 0
+    for run in re.findall(r"\d+", spec):
+        if len(run) > _MAX_WIDTH_DIGITS:
+            return _MAX_FORMAT_WIDTH + 1
+        total += int(run)
+    return total
+
+
+def _percent_width(template: str) -> int:
+    """Upper bound on the padding a %-format template demands, scanning
+    only the width/precision of actual conversion specs. A ``*`` width
+    (taken from the argument tuple at runtime) cannot be priced statically
+    and is refused."""
+    total = 0
+    for width, precision in _PERCENT_SPEC.findall(template):
+        for part in (width, precision):
+            if part == "*":
+                raise SandboxCostExceeded(
+                    "dynamic '*' width in %-formatting is not allowed")
+            if part:
+                if len(part) > _MAX_WIDTH_DIGITS:
+                    return _MAX_FORMAT_WIDTH + 1
+                total += int(part)
+    return total
 
 
 def validate(source: str) -> ast.Module:
@@ -94,6 +155,26 @@ def validate(source: str) -> ast.Module:
             raise SandboxViolation(
                 f"line {node.lineno}: access to underscore attribute "
                 f"{node.attr!r} is not allowed")
+        if isinstance(node, ast.Attribute) and node.attr in _WIDTH_METHODS:
+            raise SandboxViolation(
+                f"line {node.lineno}: {node.attr!r} is not allowed in "
+                f"sandboxed contract code (unbounded-width formatting; "
+                f"use the format() builtin)")
+        # f-string format specs are the same width surface as format():
+        # reject dynamic specs and oversized constant widths up front.
+        if isinstance(node, ast.FormattedValue) and \
+                node.format_spec is not None:
+            parts = []
+            for piece in node.format_spec.values:
+                if not isinstance(piece, ast.Constant):
+                    raise SandboxViolation(
+                        f"line {node.lineno}: dynamic f-string format "
+                        f"spec is not allowed")
+                parts.append(str(piece.value))
+            if _spec_width("".join(parts)) > _MAX_FORMAT_WIDTH:
+                raise SandboxViolation(
+                    f"line {node.lineno}: f-string format width exceeds "
+                    f"{_MAX_FORMAT_WIDTH}")
         # ANY underscore-prefixed name is banned (not just dunders): the
         # cost-accounting hooks are injected under single-underscore names
         # after validation, so user source must never be able to name (and
@@ -150,7 +231,7 @@ class _CostTransformer(ast.NodeTransformer):
     # a guarded helper that prices the result size against the budget
     # before evaluating.
     _GUARDED_OPS = {ast.Pow: "**", ast.Mult: "*", ast.LShift: "<<",
-                    ast.Add: "+"}
+                    ast.Add: "+", ast.Mod: "%"}
 
     def visit_BinOp(self, node):
         node = self.generic_visit(node)
@@ -281,6 +362,8 @@ class DeterministicSandbox:
             import operator as _op
             inplace = op.endswith("=")
             base_op = op[:-1] if inplace else op
+            if base_op == "%":
+                return guarded_mod(op, left, right)
             if base_op == "**":
                 # |base| <= 1 powers are O(1) no matter the exponent
                 if isinstance(left, int) and isinstance(right, int) \
@@ -322,6 +405,23 @@ class DeterministicSandbox:
                 apply = _op.imul if inplace else _op.mul
             return apply(left, right)
 
+        def guarded_mod(op: str, left, right):
+            """%-formatting prices the widths its spec string demands BEFORE
+            evaluating ('%0200000000d' % 1 is a 200 MB allocation for ~2
+            charged units otherwise — ADVICE r2). Numeric modulo passes
+            through at flat statement cost."""
+            import operator as _op
+            if isinstance(left, (str, bytes, bytearray)):
+                template = (left if isinstance(left, str)
+                            else left.decode("latin-1"))
+                width = _percent_width(template)
+                if width > _MAX_FORMAT_WIDTH:
+                    raise SandboxCostExceeded(
+                        f"%-format width {width} exceeds "
+                        f"{_MAX_FORMAT_WIDTH}")
+                charge(max(1, (len(left) + width) // 64))
+            return (_op.imod if op.endswith("=") else _op.mod)(left, right)
+
         def guarded_pow(base, exp, mod=None):
             if mod is not None:
                 charge(_size_units(base) + _size_units(exp) +
@@ -347,6 +447,18 @@ class DeterministicSandbox:
             charge(max(1, n // 64))
             return r
 
+        def guarded_format(value, spec=""):
+            """format() with the spec's width priced before evaluation
+            (format(1, '>200000000') is a one-call 200 MB allocation
+            otherwise — ADVICE r2)."""
+            if isinstance(spec, str) and spec:
+                width = _spec_width(spec)
+                if width > _MAX_FORMAT_WIDTH:
+                    raise SandboxCostExceeded(
+                        f"format width {width} exceeds {_MAX_FORMAT_WIDTH}")
+                charge(max(1, width // 64))
+            return format(value, spec)
+
         def guarded_bytes(*args):
             if args and isinstance(args[0], int) \
                     and not isinstance(args[0], bool):
@@ -364,6 +476,7 @@ class DeterministicSandbox:
         safe_builtins["pow"] = guarded_pow
         safe_builtins["range"] = guarded_range
         safe_builtins["bytes"] = guarded_bytes
+        safe_builtins["format"] = guarded_format
         namespace = {
             "__builtins__": safe_builtins,
             "__name__": "sandboxed_contract",

@@ -97,6 +97,15 @@ class TcpMessagingService(MessagingService):
         if self.tls is not None:
             from .tls import peer_common_name
             cert_cn = peer_common_name(writer.get_extra_info("ssl_object"))
+            if cert_cn is None:
+                # a verified cert without a CN (e.g. SAN-only) must not
+                # silently downgrade to the frame's self-declared sender —
+                # the transport-authenticated identity is what BFT
+                # state-transfer tallies trust (ADVICE r2). Refuse the
+                # connection instead of falling back.
+                log.warning("TLS peer certificate has no CN; closing")
+                writer.close()
+                return
         try:
             while True:
                 header = await reader.readexactly(4)

@@ -328,7 +328,11 @@ struct Core {
     a.peer = from_leader;
     a.a = current_term;
     a.flag = 1;
-    a.b = last_index();
+    // match index = last entry THIS append verified, not last_index():
+    // with conflict-only truncation the local log can extend past the
+    // verified entries, and last_index() would let a batching leader
+    // commit entries this follower does not hold (ADVICE r2)
+    a.b = prev_idx + static_cast<int64_t>(entries.size());
     emit(std::move(a));
   }
 

@@ -152,6 +152,26 @@ def test_append_response_match_index_clamped():
     leader._send_append(peer)   # must not raise
 
 
+def test_append_response_reports_verified_match_only():
+    """ADVICE r2: a successful append must report match = prev + len(entries),
+    not last_index() — with conflict-only truncation the local log can extend
+    past the verified entries, and overstating match would let a batching
+    leader commit entries the follower does not hold."""
+    from corda_tpu.consensus.raft import AppendEntries, LogEntry
+
+    bus, nodes = make_cluster(3)
+    follower = nodes[0]
+    follower.state.current_term = 2
+    # local log extends past what the incoming (duplicate) append covers
+    follower.state.log = [LogEntry(1, "a"), LogEntry(1, "b"), LogEntry(2, "c")]
+    captured = []
+    follower._post = lambda to, msg: captured.append((to, msg))
+    follower._on_append(AppendEntries(2, "raft1", 0, 0,
+                                      (LogEntry(1, "a"),), 0))
+    to, resp = captured[-1]
+    assert resp.success and resp.match_index == 1  # prev(0) + entries(1)
+
+
 def test_raft_uniqueness_provider_conflicts():
     bus = InMemoryMessagingNetwork()
     names = [f"raft{i}" for i in range(3)]

@@ -90,6 +90,42 @@ def test_native_leader_failure_reelection():
     assert all(log == ["before-crash", "after-crash"] for log in live)
 
 
+def test_native_append_response_reports_verified_match_only():
+    """ADVICE r2 (C++ side): a duplicate append covering a prefix of the
+    local log must report match = prev + len(entries), not last_index()."""
+    from corda_tpu.consensus.raft import (AppendEntries, AppendResponse,
+                                          LogEntry, TOPIC_RAFT)
+    from corda_tpu.consensus.raftcore import NativeRaftNode
+    from corda_tpu.core.serialization import deserialize, serialize
+    from corda_tpu.network.messaging import TopicSession
+
+    bus = InMemoryMessagingNetwork()
+    leader_ep = bus.create_node("raft0")
+    responses = []
+    leader_ep.add_message_handler(
+        TopicSession(TOPIC_RAFT),
+        lambda msg: responses.append(deserialize(msg.data)))
+    follower = NativeRaftNode(
+        "raft1", ["raft0", "raft1"], bus.create_node("raft1"),
+        lambda e: None, seed=1)
+    # build a 3-entry log on the follower
+    leader_ep.send(TopicSession(TOPIC_RAFT), serialize(AppendEntries(
+        1, "raft0", 0, 0,
+        (LogEntry(1, "a"), LogEntry(1, "b"), LogEntry(1, "c")), 0)), "raft1")
+    bus.run_network()
+    full = [m for m in responses if isinstance(m, AppendResponse)]
+    assert full and full[-1].success and full[-1].match_index == 3
+    # duplicate append covering only the first entry
+    leader_ep.send(TopicSession(TOPIC_RAFT), serialize(AppendEntries(
+        1, "raft0", 0, 0, (LogEntry(1, "a"),), 0)), "raft1")
+    bus.run_network()
+    dup = [m for m in responses if isinstance(m, AppendResponse)][-1]
+    assert dup.success and dup.match_index == 1  # prev(0) + entries(1)
+    # log not truncated by the duplicate
+    from corda_tpu.consensus import raftcore as rc
+    assert rc._LIB.raft_last_index(follower._handle) == 3
+
+
 def test_mixed_native_python_cluster():
     """Wire compatibility: native and pure-Python replicas in ONE cluster
     elect a leader and replicate identically."""

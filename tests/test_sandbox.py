@@ -186,6 +186,98 @@ def test_budget_error_is_plain_exception_at_host_boundary():
         raise AssertionError("budget kill did not surface")
 
 
+def test_match_statement_rejected():
+    """ADVICE r2 (high): MatchAs/MatchStar/MatchMapping capture names are
+    raw string attributes the ast.Name underscore ban never inspects —
+    `case _sandbox_charge:` would rebind the charge hook. The whole match
+    statement is banned."""
+    for src in ("match int:\n    case _sandbox_charge:\n        pass\n",
+                "match [1]:\n    case [*_sandbox_iter]:\n        pass\n",
+                "match {}:\n    case {**_sandbox_binop}:\n        pass\n",
+                "match 1:\n    case 1:\n        pass\n"):
+        with pytest.raises(SandboxViolation, match="match statement"):
+            validate(src)
+
+
+def test_match_rebinding_cannot_neutralize_budget():
+    """The r2 exploit end-to-end: without the Match ban, rebinding the
+    charge hook lets a 50M-iteration loop run with spent==1."""
+    with pytest.raises((SandboxViolation, SandboxBudgetError)):
+        DeterministicSandbox(instruction_budget=1000).load(
+            "match int:\n    case _sandbox_charge:\n        pass\n"
+            "while True:\n    x = 1\n")
+
+
+def test_format_width_blowups_capped():
+    """ADVICE r2 (medium): string-formatting surfaces must not allocate
+    hundreds of MB for ~2 charged units."""
+    for src in ("x = format(1, '>200000000')",
+                "x = '%0200000000d' % 1",
+                "y = '%0200000000d'\ny %= 1",
+                # review r3: '*' takes the width from the argument tuple and
+                # can't be priced statically — refused outright
+                "x = '%*d' % (50000000, 1)",
+                "x = '%.*f' % (50000000, 1.0)",
+                # review r3: mapping-key specs carry the same width surface
+                "x = '%(k)050000000d' % {'k': 1}"):
+        with pytest.raises(SandboxBudgetError):
+            DeterministicSandbox(instruction_budget=100_000).load(src)
+
+
+def test_huge_digit_runs_do_not_escape_as_valueerror():
+    """Review r3: digit runs past CPython's int-to-str limit (4300, and
+    per-process configurable) must surface as the sandbox's own exceptions,
+    not a raw ValueError."""
+    run = "9" * 5000
+    with pytest.raises(SandboxViolation):
+        validate(f"x = f'{{1:>{run}}}'")
+    with pytest.raises(SandboxBudgetError):
+        DeterministicSandbox().load(f"x = '%{run}d' % 1")
+    with pytest.raises(SandboxBudgetError):
+        DeterministicSandbox().load(f"x = format(1, '>{run}')")
+
+
+def test_literal_digits_in_percent_template_are_free():
+    """Review r3: only conversion-spec widths count — large numeric literals
+    in the template text are not padding."""
+    ns = DeterministicSandbox().load(
+        "x = 'block 20260730123456: %d' % 7\n"
+        "y = '100%% of %5d' % 42\n")
+    assert ns["x"] == "block 20260730123456: 7"
+    assert ns["y"] == "100% of    42"
+
+
+def test_width_taking_str_methods_banned():
+    for src in ("x = 'a'.ljust(200000000)",
+                "x = 'a'.rjust(9)",
+                "x = 'a'.center(9)",
+                "x = '1'.zfill(9)",
+                "x = '\\t'.expandtabs(200000000)",
+                "x = '{:>200000000}'.format(1)",
+                "x = '{v}'.format_map({'v': 1})"):
+        with pytest.raises(SandboxViolation, match="formatting"):
+            validate(src)
+
+
+def test_fstring_width_rejected():
+    with pytest.raises(SandboxViolation, match="width"):
+        validate("x = f'{1:>200000000}'")
+    with pytest.raises(SandboxViolation, match="dynamic"):
+        validate("w = 9\nx = f'{1:>{w}}'")
+
+
+def test_formatting_still_correct():
+    ns = DeterministicSandbox().load(
+        "a = format(255, '08x')\n"
+        "b = '%05d' % 42\n"
+        "c = f'{3.14159:.2f}'\n"
+        "d = 17 % 5\n"
+        "e = 17\n"
+        "e %= 5\n")
+    assert ns["a"] == "000000ff" and ns["b"] == "00042"
+    assert ns["c"] == "3.14" and ns["d"] == 2 and ns["e"] == 2
+
+
 def test_bindings_visible():
     sandbox = DeterministicSandbox()
     ns = sandbox.load("answer = helper(20)", bindings={"helper": lambda v: v * 2 + 2})

@@ -83,6 +83,27 @@ def test_plaintext_client_rejected(tmp_path):
         plain.stop()
 
 
+def test_cn_less_cert_refused(tmp_path, monkeypatch):
+    """ADVICE r2: a verified certificate WITHOUT a CN (e.g. SAN-only) must
+    not silently downgrade to the frame's self-declared sender — the
+    connection is refused instead."""
+    from corda_tpu.network import tls as tls_mod
+    directory = {}
+    resolve = directory.get
+    server = _endpoint(tmp_path, "server", resolve)
+    client = _endpoint(tmp_path, "client", resolve)
+    directory["server"] = ("127.0.0.1", server.port)
+    monkeypatch.setattr(tls_mod, "peer_common_name", lambda ssl_obj: None)
+    try:
+        got = []
+        server.add_message_handler(TopicSession("t", 1), got.append)
+        client.send(TopicSession("t", 1), b"anonymous", "server")
+        assert not _wait_for(lambda: got, timeout=2.5)
+    finally:
+        server.stop()
+        client.stop()
+
+
 def test_dev_ca_created_once(tmp_path):
     c1 = ensure_dev_ca(str(tmp_path / "shared"))
     with open(c1[0], "rb") as f:
