@@ -79,15 +79,58 @@ def device_rate(items) -> float:
     return len(items) * REPS / dt
 
 
+def service_metrics(items):
+    """The SERVICE-path numbers (VERDICT r2 #1b/c): verifies/s through the
+    SignatureBatcher seam (host prep + device kernel + future resolution —
+    what a node actually gets), and p50 latency @ batch=1 (the host-crossover
+    path: a lone check must not pay the ~140 ms device dispatch floor)."""
+    from corda_tpu.core.crypto.keys import PublicKey, sec1_compress
+    from corda_tpu.core.crypto.schemes import ECDSA_SECP256K1_SHA256
+    from corda_tpu.verifier.batcher import SignatureBatcher
+
+    triples = [(PublicKey(ECDSA_SECP256K1_SHA256,
+                          sec1_compress(ecmath.SECP256K1, pub)),
+                ecmath.ecdsa_sig_to_der(r, s), msg)
+               for _, pub, msg, r, s in items]
+    batcher = SignatureBatcher()
+    try:
+        for f in batcher.submit_many(triples):     # compile + warm
+            assert f.result(timeout=600)
+        # continuous stream: all reps queued up front so the dispatcher's
+        # one-deep pipeline overlaps batch N+1's host prep with batch N's
+        # device compute (the service's steady-state shape)
+        t0 = time.perf_counter()
+        futs = []
+        for _ in range(REPS):
+            futs.extend(batcher.submit_many(triples))
+        for f in futs:
+            assert f.result(timeout=600)
+        service_rate = len(futs) / (time.perf_counter() - t0)
+        latencies = []
+        for i in range(41):
+            key, der, msg = triples[i % len(triples)]
+            t0 = time.perf_counter()
+            assert batcher.submit(key, der, msg).result(timeout=60)
+            latencies.append(time.perf_counter() - t0)
+        p50_ms = sorted(latencies)[len(latencies) // 2] * 1000.0
+    finally:
+        batcher.close()
+    return service_rate, p50_ms
+
+
 def main() -> None:
     items = make_items(BATCH)
     dev = device_rate(items)
+    service_rate, p50_ms = service_metrics(items)
     host = host_baseline_rate(items[: min(128, BATCH)])
     print(json.dumps({
         "metric": "ecdsa_secp256k1_verifies_per_sec_per_chip",
         "value": round(dev, 1),
         "unit": "verifies/s",
         "vs_baseline": round(dev / host, 3),
+        "service_path_verifies_per_sec": round(service_rate, 1),
+        "tx_verify_p50_ms_batch1": round(p50_ms, 3),
+        "host_baseline_verifies_per_sec": round(host, 1),
     }))
 
 

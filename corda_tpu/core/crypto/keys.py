@@ -12,6 +12,7 @@ Reference parity: Crypto.kt key generation + key classes; CryptoUtils.kt helpers
 """
 from __future__ import annotations
 
+import functools
 import os
 from dataclasses import dataclass, field
 from functools import total_ordering
@@ -95,6 +96,21 @@ class KeyPair:
 def sec1_compress(curve: ecmath.WeierstrassCurve, point) -> bytes:
     x, y = point
     return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def sec1_decompress_cached(curve: ecmath.WeierstrassCurve, data: bytes):
+    """sec1_decompress with the modular square root memoized per (curve,
+    encoding). Decompression costs a 256-bit modpow; verification workloads
+    see the same signer keys over and over (per-party keys across a ledger),
+    so the batcher's host prep rides this cache."""
+    return _decompress_lru(curve.name, data)
+
+
+@functools.lru_cache(maxsize=65536)
+def _decompress_lru(curve_name: str, data: bytes):
+    curve = (ecmath.SECP256K1 if curve_name == "secp256k1"
+             else ecmath.SECP256R1)
+    return sec1_decompress(curve, data)
 
 
 def sec1_decompress(curve: ecmath.WeierstrassCurve, data: bytes):

@@ -9,6 +9,7 @@ signing path, and the fallback for schemes with no device kernel (RSA).
 """
 from __future__ import annotations
 
+import functools
 import hashlib
 from dataclasses import dataclass
 
@@ -60,6 +61,70 @@ class DigitalSignatureWithKey(DigitalSignature):
 TransactionSignature = DigitalSignatureWithKey
 
 
+def _openssl_ecdsa_verify(scheme_id: int, encoded: bytes, content: bytes,
+                          r: int, s: int):
+    """OpenSSL-backed ECDSA curve-equation check, or None when the
+    ``cryptography`` package is unavailable. Policy (ranges, low-s, curve
+    membership, DER canonicalisation) is enforced by the CALLER; the (r, s)
+    pair is re-encoded to canonical DER here so OpenSSL never sees the
+    caller's encoding quirks."""
+    try:
+        key = _openssl_key(scheme_id, encoded)
+    except Exception:
+        return None
+    if key is None:
+        return None
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    try:
+        key.verify(ecmath.ecdsa_sig_to_der(r, s), content,
+                   ec.ECDSA(hashes.SHA256()))
+        return True
+    except InvalidSignature:
+        return False
+
+
+def _openssl_ed25519_verify(encoded: bytes, content: bytes, signature: bytes):
+    """OpenSSL-backed Ed25519 equation check, or None when unavailable.
+    Structural policy is enforced by the CALLER with our own decoder."""
+    try:
+        key = _openssl_ed_key(encoded)
+    except Exception:
+        return None
+    if key is None:
+        return None
+    from cryptography.exceptions import InvalidSignature
+    try:
+        key.verify(signature, content)
+        return True
+    except InvalidSignature:
+        return False
+
+
+@functools.lru_cache(maxsize=65536)
+def _openssl_ed_key(encoded: bytes):
+    try:
+        from cryptography.hazmat.primitives.asymmetric import ed25519
+    except ImportError:
+        return None
+    return ed25519.Ed25519PublicKey.from_public_bytes(encoded)
+
+
+@functools.lru_cache(maxsize=65536)
+def _openssl_key(scheme_id: int, encoded: bytes):
+    """Decode + cache an OpenSSL EC public key object per encoding (the
+    point decompression is the expensive part and keys repeat heavily)."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric import ec
+    except ImportError:
+        return None
+    curve_obj = (ec.SECP256K1()
+                 if scheme_id == ECDSA_SECP256K1_SHA256.scheme_number_id
+                 else ec.SECP256R1())
+    return ec.EllipticCurvePublicKey.from_encoded_point(curve_obj, encoded)
+
+
 class Crypto:
     """Scheme dispatch (mirror of the reference ``Crypto`` object)."""
 
@@ -101,6 +166,18 @@ class Crypto:
     def is_valid(public: PublicKey, signature: bytes, content: bytes) -> bool:
         sid = public.scheme.scheme_number_id
         if sid == EDDSA_ED25519_SHA512.scheme_number_id:
+            # structural policy (canonical point decodes, s < L) decided by
+            # OUR decoder — identical to the device kernel precheck; the
+            # verification equation itself then rides OpenSSL when present
+            # (RFC 8032 cofactorless, same equation as ecmath/kernels)
+            if (len(signature) != 64
+                    or ecmath.ed_point_decompress(public.encoded) is None
+                    or ecmath.ed_point_decompress(signature[:32]) is None
+                    or int.from_bytes(signature[32:], "little") >= ecmath.ED_L):
+                return False
+            fast = _openssl_ed25519_verify(public.encoded, content, signature)
+            if fast is not None:
+                return fast
             return ecmath.ed25519_verify(public.encoded, content, signature)
         if sid in (ECDSA_SECP256K1_SHA256.scheme_number_id,
                    ECDSA_SECP256R1_SHA256.scheme_number_id):
@@ -112,6 +189,19 @@ class Crypto:
                 r, s = ecmath.ecdsa_sig_from_der(signature)
             except (ValueError, IndexError):
                 return False
+            # The acceptance POLICY (ranges incl. low-s, on-curve key,
+            # canonical DER) is decided above/by ecdsa_verify's prechecks —
+            # identically to the device kernels' precheck. Once policy
+            # passes, the curve-equation check itself is implementation-
+            # independent, so the host path may ride OpenSSL (~100x the
+            # pure-Python ladder; this is the batcher's sub-crossover /
+            # p50@batch=1 path) with the pure ladder as fallback oracle.
+            if not (1 <= r < curve.n and 1 <= s <= curve.n // 2):
+                return False
+            fast = _openssl_ecdsa_verify(public.scheme.scheme_number_id,
+                                         public.encoded, content, r, s)
+            if fast is not None:
+                return fast
             return ecmath.ecdsa_verify(curve, point, content, r, s)
         if sid == RSA_SHA256.scheme_number_id:
             from cryptography.hazmat.primitives.asymmetric import padding
@@ -130,6 +220,7 @@ class Crypto:
 
     @staticmethod
     def do_verify(public: PublicKey, signature: bytes, content: bytes) -> bool:
+        """Throwing verify (doVerify semantics, Crypto.kt:438-511)."""
         if not content:
             raise SignatureException("Signing of an empty array is not permitted")
         if not Crypto.is_valid(public, signature, content):

@@ -63,10 +63,20 @@ def _c_limbs_of(p: int) -> list[int]:
 # ---------------------------------------------------------------------------
 
 def to_limbs(x, n: int = NLIMB) -> np.ndarray:
-    """Python int(s) → u64 limb array ((n,) or (B, n)), canonical limbs."""
+    """Python int(s) → u64 limb array ((n,) or (B, n)), canonical limbs.
+
+    The batch path packs each value to little-endian bytes and views them as
+    u16 limbs in one numpy pass — one Python-level call per value instead of
+    ``n`` bigint shift/mask pairs (this was the dominant cost of the service
+    path's host prep at 32k batches)."""
     if isinstance(x, (int, np.integer)):
         return np.array([(int(x) >> (LIMB_BITS * i)) & MASK for i in range(n)],
                         dtype=np.uint64)
+    if LIMB_BITS == 16:
+        nbytes = n * 2
+        buf = b"".join(int(v).to_bytes(nbytes, "little") for v in x)
+        return np.frombuffer(buf, dtype="<u2").reshape(
+            len(x), n).astype(np.uint64)
     return np.stack([to_limbs(int(v), n) for v in x])
 
 
@@ -552,4 +562,7 @@ def scalars_to_bits(xs, nbits: int = 256) -> np.ndarray:
         # silent truncation) any scalar using the sliced-off high bits
         assert not bits[:, : 8 * nbytes - nbits].any(), \
             f"scalar exceeds {nbits} bits"
-    return np.ascontiguousarray(bits[:, -nbits:].T).astype(np.uint32)
+    # u8 on the wire: bit planes are 0/1 and the kernels upcast on device —
+    # shipping u32/u64 through the host↔device link was 4-8x the bytes for
+    # no information (the service path is transfer-bound at 32k batches)
+    return np.ascontiguousarray(bits[:, -nbits:].T).astype(np.uint8)

@@ -13,5 +13,7 @@ from .sharded import (  # noqa: F401
     sharded_ecdsa_verify,
     sharded_ecdsa_verify_hybrid,
     sharded_merkle_root,
+    sharded_verify_batch_ed25519,
+    sharded_verify_batch_secp256k1,
     tx_verify_step,
 )

@@ -124,6 +124,47 @@ def sharded_merkle_root(mesh: Mesh):
     return jax.jit(shmapped)
 
 
+def _pad_to_mesh_bucket(n: int, mesh: Mesh) -> int:
+    """Bucket size that is mesh-divisible with a power-of-two PER-SHARD
+    count (one compile per per-shard bucket). Computed as pow2(ceil(n/d))·d
+    so it terminates for any device count, including non-powers-of-two."""
+    from ..ops import field as F
+    d = mesh.devices.size
+    return F.bucket_size(-(-n // d)) * d
+
+
+def sharded_verify_batch_ed25519(mesh: Mesh, items, _cache={}):
+    """[(pub32, sig64, msg)] → bool verdicts (B,), the batch dp-sharded over
+    ``mesh`` — the drop-in mesh backend for the SignatureBatcher
+    (ops.ed25519.verify_batch semantics, N chips instead of one)."""
+    n = len(items)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    padded = items + [items[-1]] * (_pad_to_mesh_bucket(n, mesh) - n)
+    s_bits, k_bits, neg_a, r_affine, precheck = ed_ops.prepare_batch(padded)
+    key = ("ed25519", id(mesh))
+    if key not in _cache:
+        _cache[key] = sharded_ed25519_verify(mesh)
+    ok = np.asarray(_cache[key](s_bits, k_bits, neg_a, r_affine))
+    return (ok & precheck)[:n]
+
+
+def sharded_verify_batch_secp256k1(mesh: Mesh, items, _cache={}):
+    """[(pub_point, msg, r, s)] → bool verdicts (B,) via the hybrid GLV
+    kernel, batch dp-sharded over ``mesh``."""
+    n = len(items)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    padded = items + [items[-1]] * (_pad_to_mesh_bucket(n, mesh) - n)
+    g_idx, q_bits, Qc, Qd, r_cands, precheck = \
+        wc_ops.prepare_batch_hybrid(padded)
+    key = ("secp256k1", id(mesh))
+    if key not in _cache:
+        _cache[key] = sharded_ecdsa_verify_hybrid(mesh)
+    ok = np.asarray(_cache[key](g_idx, q_bits, Qc, Qd, r_cands))
+    return (ok & precheck)[:n]
+
+
 def tx_verify_step(mesh: Mesh):
     """The flagship full device step: one batch of transaction work —
     Ed25519 signature checks (dp-sharded) + Merkle component rooting

@@ -38,11 +38,40 @@ class NodeHandle:
             self.process.kill()
 
 
+@dataclass
+class VerifierHandle:
+    """A standalone verifier worker subprocess (VerifierDriver.startVerifier
+    analog, verifier/src/integration-test/.../VerifierDriver.kt:50-68)."""
+
+    host: str
+    port: int
+    process: subprocess.Popen
+    stats_file: str | None = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        """Graceful: SIGTERM lets the worker flush its stats file."""
+        self.process.terminate()
+        try:
+            self.process.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            self.process.kill()
+
+    def kill(self) -> None:
+        """Hard kill — the death-redistribution scenario."""
+        self.process.kill()
+        self.process.wait(timeout=10)
+
+
 class DriverDSL:
     def __init__(self, base_dir: str, startup_timeout_s: float = 60.0):
         self.base_dir = str(base_dir)
         self.startup_timeout_s = startup_timeout_s
         self.nodes: list[NodeHandle] = []
+        self.verifiers: list[VerifierHandle] = []
         self.map_handle: NodeHandle | None = None
         self.map_name = "O=Network Map, L=London, C=GB"
 
@@ -76,7 +105,37 @@ class DriverDSL:
                         f"{handle.name} sees fewer than {min_nodes} nodes")
                 time.sleep(0.3)
 
+    def start_verifier(self, queue_address: str, use_device: bool = True,
+                       host_crossover: int | None = None,
+                       stats_file: str | None = None,
+                       extra_env: dict | None = None) -> VerifierHandle:
+        """Spawn a standalone verifier worker subprocess attached to
+        ``queue_address`` ("host:port" of the requesting endpoint)."""
+        cmd = [sys.executable, "-m", "corda_tpu.verifier",
+               "--queue-address", queue_address, "--port", "0"]
+        if not use_device:
+            cmd.append("--no-device")
+        if host_crossover is not None:
+            cmd += ["--host-crossover", str(host_crossover)]
+        if stats_file is not None:
+            cmd += ["--stats-file", stats_file]
+        env = dict(os.environ)
+        env.setdefault("PYTHONPATH", os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env.update(extra_env or {})
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True, env=env)
+        host, port = await_node_ready(proc, "verifier",
+                                      self.startup_timeout_s,
+                                      ready_prefix="VERIFIER READY")
+        handle = VerifierHandle(host, port, proc, stats_file)
+        self.verifiers.append(handle)
+        return handle
+
     def shutdown(self) -> None:
+        for handle in reversed(self.verifiers):
+            handle.stop()
+        self.verifiers.clear()
         for handle in reversed(self.nodes):
             handle.stop()
         self.nodes.clear()
@@ -112,8 +171,9 @@ class DriverDSL:
 
 
 def await_node_ready(proc: subprocess.Popen, name: str,
-                     timeout_s: float = 60.0):
-    """Block until a node subprocess prints its NODE READY line (driver
+                     timeout_s: float = 60.0,
+                     ready_prefix: str = "NODE READY"):
+    """Block until a node subprocess prints its READY line (driver
     futures); returns (host, port). Lines are read on a helper thread so a
     silently-hung child still trips the timeout instead of blocking readline
     forever. Shared by the driver DSL and the demobench launcher."""
@@ -143,7 +203,7 @@ def await_node_ready(proc: subprocess.Popen, name: str,
             raise RuntimeError(
                 f"node {name} exited during startup:\n" + "".join(lines))
         lines.append(line)
-        if line.startswith("NODE READY"):
+        if line.startswith(ready_prefix):
             addr = line.strip().rsplit(" ", 1)[-1]
             host, _, port = addr.rpartition(":")
             return host, int(port)

@@ -1,0 +1,112 @@
+"""Standalone verifier worker process — ``python -m corda_tpu.verifier``.
+
+Reference parity: Verifier.main (verifier/src/main/.../Verifier.kt:42-79) —
+a leaf process that attaches to a node's verification queue, consumes
+requests, verifies, replies. Stateless: run N copies against one queue;
+killing one redistributes its outstanding work (the node's redelivery
+timeout or Goodbye handling, VerifierTests.kt:73+).
+
+TPU-first: the worker runs the signature EC math through its own
+``SignatureBatcher`` device kernels — consecutive requests' signatures
+coalesce into one device batch, so N worker processes = N chips of
+cross-transaction batched verification behind one competing-consumer queue.
+
+Prints ``VERIFIER READY <host>:<port>`` on stdout once attached (the driver
+DSL's readiness handshake, like the node's NODE READY line). On SIGTERM it
+writes batcher metrics to ``--stats-file`` (if given) so tests can assert
+device-verified work happened in this process, then exits cleanly.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def _literal_resolve(name: str):
+    """Workers address peers only as literal "host:port" strings."""
+    host, _, port = name.rpartition(":")
+    try:
+        return host, int(port)
+    except ValueError:
+        return None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="corda-tpu-verifier")
+    parser.add_argument("--queue-address", required=True,
+                        help="host:port of the node whose queue to consume")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--no-device", action="store_true",
+                        help="host-only verification (no kernels)")
+    parser.add_argument("--host-crossover", type=int, default=None,
+                        help="batches below this run on host (default: "
+                             "the batcher's measured crossover)")
+    parser.add_argument("--stats-file",
+                        help="write batcher metrics JSON here on shutdown")
+    parser.add_argument("--cordapp", action="append", default=None,
+                        help="modules to import so contract/state types "
+                             "deserialize (default: corda_tpu.finance + "
+                             "corda_tpu.testing.dummy)")
+    args = parser.parse_args(argv)
+
+    for module in (args.cordapp if args.cordapp is not None
+                   else ["corda_tpu.finance", "corda_tpu.testing.dummy"]):
+        importlib.import_module(module)
+
+    # persistent compile cache: repeated worker launches must not re-pay the
+    # kernel compiles (jax.config.update is the reliable path — the env-var
+    # spelling is not honored by all versions)
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if cache_dir:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    from ..network.tcp import TcpMessagingService
+    from .batcher import SignatureBatcher
+    from .out_of_process import VerifierWorker
+
+    messaging = TcpMessagingService("verifier-worker", args.host, args.port,
+                                    _literal_resolve)
+    # the worker's reachable address IS its identity: the node replies and
+    # deals work to exactly this host:port (no network-map registration,
+    # same as the reference worker attaching straight to the broker)
+    messaging._name = f"{args.host}:{messaging.port}"
+
+    batcher_kwargs = {"use_device": not args.no_device}
+    if args.host_crossover is not None:
+        batcher_kwargs["host_crossover"] = args.host_crossover
+    batcher = SignatureBatcher(**batcher_kwargs)
+    worker = VerifierWorker(messaging, args.queue_address, batcher=batcher,
+                            use_device=not args.no_device,
+                            hello_interval_s=3.0)
+
+    print(f"VERIFIER READY {args.host}:{messaging.port}", flush=True)
+
+    done = threading.Event()
+
+    def _shutdown(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    done.wait()
+
+    if args.stats_file:
+        snap = batcher.metrics.snapshot()
+        with open(args.stats_file, "w") as f:
+            json.dump({"verified_count": worker.verified_count,
+                       "metrics": snap}, f)
+    worker.stop()
+    messaging.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,7 +24,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 
 from ..core.crypto import ecmath
-from ..core.crypto.keys import PublicKey, curve_for_scheme, sec1_decompress
+from ..core.crypto.keys import PublicKey, sec1_decompress_cached
 from ..core.crypto.schemes import (
     ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256, EDDSA_ED25519_SHA512)
 from ..core.crypto.signatures import Crypto
@@ -55,18 +55,37 @@ class _null_ctx:
 
 class SignatureBatcher:
     """Accepts individual signature checks, returns Future[bool] verdicts,
-    dispatches device-batched kernels per scheme from a background thread."""
+    dispatches device-batched kernels per scheme from a background thread.
 
-    def __init__(self, max_batch: int = 512, max_latency_s: float = 0.005,
-                 metrics: MetricRegistry | None = None, use_device: bool = True):
+    Batch-size policy (VERDICT r2 #1): the cap defaults to the kernels'
+    measured throughput sweet spot (32k; BASELINE.md "the fixed ~140 ms
+    dispatch floor amortizes past batch ~8k") and the drain adapts to load —
+    kernels pad to power-of-two buckets so variable batch sizes compile once
+    per bucket, not per length. Batches *below* ``host_crossover`` route to
+    the host verify path instead: with a ~140 ms device dispatch floor and
+    ~2k verifies/s on one host core, a batch under ~200 items finishes on
+    host before the device kernel would even launch — this is what makes
+    p50 @ batch=1 milliseconds instead of the dispatch floor. Below the
+    crossover the dispatcher also skips the linger wait, so a lone submit
+    is not taxed ``max_latency_s`` for a batch that was never coming."""
+
+    def __init__(self, max_batch: int = 32768, max_latency_s: float = 0.005,
+                 metrics: MetricRegistry | None = None, use_device: bool = True,
+                 host_crossover: int = 192, mesh=None):
         self.max_batch = max_batch
         self.max_latency_s = max_latency_s
         self.metrics = metrics if metrics is not None else MetricRegistry()
         self.use_device = use_device
+        self.host_crossover = host_crossover
+        # a jax.sharding.Mesh shards every device batch over the local chips
+        # (shard_map dp axis) — one node's batcher drives the whole slice
+        self.mesh = mesh
         self._lock = threading.Condition()
         self._queues: dict[str, list[_Pending]] = {
             "ed25519": [], "secp256k1": [], "secp256r1": [], "host": []}
         self._closed = False
+        self._finish_future = None
+        self._finisher = None
         self._profile_dir = os.environ.get("CORDA_TPU_PROFILE_DIR")
         self._profiling = False
         self._batch_seq = 0
@@ -79,23 +98,33 @@ class SignatureBatcher:
                ) -> Future:
         """Future resolves to bool (valid/invalid); malformed input → False,
         matching the batch kernels' precheck semantics."""
-        p = _Pending(key, signature, content)
-        bucket = _BUCKETS.get(key.scheme.scheme_number_id, "host")
-        if not self.use_device:
-            bucket = "host"
+        return self.submit_many([(key, signature, content)])[0]
+
+    def submit_many(self, checks) -> list[Future]:
+        """Bulk submission: one lock round for a whole transaction's (or
+        ledger's) signature set — the per-item lock churn matters at the
+        32k-batch scale the service path runs."""
+        pendings = [(_Pending(key, sig, content),
+                     _BUCKETS.get(key.scheme.scheme_number_id, "host"))
+                    for key, sig, content in checks]
         with self._lock:
             if self._closed:
                 raise RuntimeError("SignatureBatcher is closed")
-            self._queues[bucket].append(p)
-            self.metrics.counter("SigBatcher.InFlight").inc()
+            for p, bucket in pendings:
+                if not self.use_device:
+                    bucket = "host"
+                self._queues[bucket].append(p)
+            self.metrics.counter("SigBatcher.InFlight").inc(len(pendings))
             self._lock.notify()
-        return p.future
+        return [p.future for p, _ in pendings]
 
     def close(self) -> None:
         with self._lock:
             self._closed = True
             self._lock.notify()
         self._thread.join(timeout=5)
+        if self._finisher is not None:
+            self._finisher.shutdown(wait=True)
         if self._profiling:
             import jax
             jax.profiler.stop_trace()
@@ -103,29 +132,47 @@ class SignatureBatcher:
 
     # -- dispatcher ----------------------------------------------------------
     def _run(self) -> None:
+        # One-deep pipeline across TWO threads: this thread preps + launches
+        # batch N+1 while the finisher thread blocks on batch N's device
+        # result (a GIL-releasing wait), then resolves its futures. Host
+        # prep was ~half of the unpipelined service-path cost — overlapping
+        # it with the device round-trip is most of the service-vs-kernel gap.
+        self._finish_future = None
         while True:
             with self._lock:
-                while not self._closed and not any(self._queues.values()):
+                while (not self._closed and not any(self._queues.values())
+                       and self._finish_future is None):
                     self._lock.wait()
-                if not any(self._queues.values()):
-                    if self._closed:
-                        return
-                    continue
-                # linger briefly to let a batch accumulate
-                if (max(len(q) for q in self._queues.values()) < self.max_batch
-                        and not self._closed):
+                if not any(self._queues.values()) and \
+                        self._finish_future is None and self._closed:
+                    return
+                # linger only when a device-scale batch is building: below
+                # the host crossover these items go to the host path anyway,
+                # so waiting would add pure latency (the p50@1 case)
+                depth = max((len(q) for q in self._queues.values()),
+                            default=0)
+                if (self.host_crossover <= depth < self.max_batch
+                        and not self._closed and any(self._queues.values())):
                     self._lock.wait(timeout=self.max_latency_s)
                 drained = {name: q[: self.max_batch]
                            for name, q in self._queues.items() if q}
                 for name, items in drained.items():
                     del self._queues[name][: len(items)]
+            if not drained:
+                self._await_finisher()
+                continue
             for name, items in drained.items():
-                self._dispatch(name, items)
+                if name == "host" or len(items) < self.host_crossover:
+                    if name != "host":
+                        self.metrics.meter("SigBatcher.HostRouted").mark(
+                            len(items))
+                    self._resolve("host", items, self._run_host(items))
+                else:
+                    self._dispatch_device(name, items)
 
-    def _dispatch(self, bucket: str, items: list[_Pending]) -> None:
-        timer = self.metrics.timer(f"SigBatcher.{bucket}.Duration")
+    def _dispatch_device(self, bucket: str, items: list[_Pending]) -> None:
         profile_ctx = None
-        if self._profile_dir is not None and bucket != "host":
+        if self._profile_dir is not None:
             import jax
             if not self._profiling:
                 jax.profiler.start_trace(self._profile_dir)
@@ -134,47 +181,115 @@ class SignatureBatcher:
             profile_ctx = jax.profiler.StepTraceAnnotation(
                 f"verify-{bucket}", step_num=self._batch_seq)
         try:
-            with timer, (profile_ctx or _null_ctx()):
+            with self.metrics.timer(f"SigBatcher.{bucket}.Prep"), \
+                    (profile_ctx or _null_ctx()):
+                if self.mesh is not None:
+                    # mesh path resolves immediately (sharded helpers force)
+                    if bucket == "ed25519":
+                        verdicts = self._run_ed25519(items)
+                    else:
+                        verdicts = self._run_ecdsa(bucket, items)
+                    self._mark_device(items)
+                    self._resolve(bucket, items, verdicts)
+                    return
+                # host prep HERE — overlaps the finisher's device wait
                 if bucket == "ed25519":
-                    verdicts = self._run_ed25519(items)
-                elif bucket in ("secp256k1", "secp256r1"):
-                    verdicts = self._run_ecdsa(bucket, items)
+                    pending, finish = self._start_ed25519(items)
                 else:
-                    verdicts = []
-                    for p in items:
-                        try:
-                            verdicts.append(
-                                Crypto.is_valid(p.key, p.signature, p.content))
-                        except Exception:
-                            verdicts.append(False)
-        except Exception as e:  # batch-level failure → fail every member
-            for p in items:
-                if not p.future.done():
-                    p.future.set_exception(e)
+                    pending, finish = self._start_ecdsa(bucket, items)
+        except Exception:
+            # batch-level failure (kernel/compile/transfer): fall back to
+            # per-item host verification so one malformed member — or a
+            # transient device error — cannot fail unrelated transactions'
+            # futures (VERDICT r2 weak #9)
             self.metrics.meter("SigBatcher.BatchFailure").mark()
-            self.metrics.counter("SigBatcher.InFlight").dec(len(items))
+            self._resolve(bucket, items, self._run_host(items))
             return
+        self._await_finisher()     # pipeline depth 1
+        if self._finisher is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._finisher = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="sig-batcher-finish")
+        self._finish_future = self._finisher.submit(
+            self._finish_one, bucket, items, pending, finish)
+
+    def _await_finisher(self) -> None:
+        fut = self._finish_future
+        if fut is not None:
+            self._finish_future = None
+            fut.result()
+
+    def _finish_one(self, bucket, items, pending, finish) -> None:
+        try:
+            with self.metrics.timer(f"SigBatcher.{bucket}.Duration"):
+                verdicts = finish(pending)
+            self._mark_device(items)
+        except Exception:
+            self.metrics.meter("SigBatcher.BatchFailure").mark()
+            verdicts = self._run_host(items)
+        self._resolve(bucket, items, verdicts)
+
+    def _mark_device(self, items) -> None:
+        self.metrics.meter("SigBatcher.DeviceBatches").mark()
+        self.metrics.meter("SigBatcher.DeviceChecked").mark(len(items))
+
+    def _resolve(self, bucket: str, items: list[_Pending], verdicts) -> None:
         for p, ok in zip(items, verdicts):
             p.future.set_result(bool(ok))
         self.metrics.meter("SigBatcher.Checked").mark(len(items))
         self.metrics.counter("SigBatcher.InFlight").dec(len(items))
 
     @staticmethod
-    def _run_ed25519(items: list[_Pending]):
+    def _run_host(items: list[_Pending]) -> list[bool]:
+        verdicts = []
+        for p in items:
+            try:
+                verdicts.append(Crypto.is_valid(p.key, p.signature, p.content))
+            except Exception:
+                verdicts.append(False)
+        return verdicts
+
+    def _run_ed25519(self, items: list[_Pending]):
+        triples = [(p.key.encoded, p.signature, p.content) for p in items]
+        if self.mesh is not None:
+            from ..parallel import sharded_verify_batch_ed25519
+            return sharded_verify_batch_ed25519(self.mesh, triples)
         from ..ops import ed25519 as ed_ops
-        return ed_ops.verify_batch(
-            [(p.key.encoded, p.signature, p.content) for p in items])
+        return ed_ops.verify_batch(triples)
 
     @staticmethod
-    def _run_ecdsa(bucket: str, items: list[_Pending]):
-        from ..ops import weierstrass as wc_ops
-        curve = ecmath.SECP256K1 if bucket == "secp256k1" else ecmath.SECP256R1
+    def _start_ed25519(items: list[_Pending]):
+        from ..ops import ed25519 as ed_ops
+        pending = ed_ops.verify_batch_async(
+            [(p.key.encoded, p.signature, p.content) for p in items])
+        return pending, ed_ops.finish_batch
+
+    @staticmethod
+    def _ecdsa_kernel_items(curve, items: list[_Pending]):
         kitems = []
         for p in items:
-            point = sec1_decompress(curve_for_scheme(p.key.scheme), p.key.encoded)
+            # per-item isolation: ANY malformed member becomes a False
+            # verdict for that member alone, never a batch failure
             try:
+                point = sec1_decompress_cached(curve, p.key.encoded)
                 r, s = ecmath.ecdsa_sig_from_der(p.signature)
-            except (ValueError, IndexError):
-                r, s = 0, 0  # fails the kernel's range precheck → False
+            except Exception:
+                point, r, s = None, 0, 0  # fails the range precheck → False
             kitems.append((point, p.content, r, s))
+        return kitems
+
+    def _run_ecdsa(self, bucket: str, items: list[_Pending]):
+        from ..ops import weierstrass as wc_ops
+        curve = ecmath.SECP256K1 if bucket == "secp256k1" else ecmath.SECP256R1
+        kitems = self._ecdsa_kernel_items(curve, items)
+        if self.mesh is not None and bucket == "secp256k1":
+            from ..parallel import sharded_verify_batch_secp256k1
+            return sharded_verify_batch_secp256k1(self.mesh, kitems)
         return wc_ops.verify_batch(curve, kitems)
+
+    def _start_ecdsa(self, bucket: str, items: list[_Pending]):
+        from ..ops import weierstrass as wc_ops
+        curve = ecmath.SECP256K1 if bucket == "secp256k1" else ecmath.SECP256R1
+        pending = wc_ops.verify_batch_async(
+            curve, self._ecdsa_kernel_items(curve, items))
+        return pending, wc_ops.finish_batch

@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import itertools
 import logging
+import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Any
@@ -36,9 +38,20 @@ log = logging.getLogger(__name__)
 
 @dataclass(frozen=True)
 class VerificationRequest:
+    """One transaction's verification work unit (VerifierApi.kt:33-37).
+
+    TPU-first extension over the reference shape: ``signatures`` carries the
+    (public key, signature bytes, signed content) triples of the enclosing
+    SignedTransaction so the WORKER runs them through its device batcher —
+    N workers × cross-request batching is the scale-out story
+    (Verifier.kt:42-79) with the EC math actually on the accelerator.
+    Empty signatures = reference semantics (ltx platform/contract rules
+    only, host-side)."""
+
     verification_id: int
     transaction: Any          # LedgerTransaction
     response_address: str
+    signatures: tuple = ()    # ((PublicKey, sig_bytes, content_bytes), ...)
 
 
 @dataclass(frozen=True)
@@ -66,15 +79,25 @@ for _cls in (VerificationRequest, VerificationResponse, WorkerHello,
 
 class VerifierRequestQueue:
     """Node-side queue with competing-consumer semantics. Attach it to the
-    node's messaging; workers announce themselves with WorkerHello."""
+    node's messaging; workers announce themselves with WorkerHello.
 
-    def __init__(self, network_service):
+    Guarded by one lock: control messages arrive on the messaging executor,
+    submissions on flow/RPC threads, and overdue-redelivery scans on a timer
+    thread. ``redelivery_timeout_s`` is the Artemis-redelivery analog for
+    REAL transports, where a killed worker process never sends Goodbye: a
+    request outstanding longer than the timeout declares its worker dead and
+    requeues everything it held."""
+
+    def __init__(self, network_service, redelivery_timeout_s: float | None = None):
         self.network_service = network_service
+        self.redelivery_timeout_s = redelivery_timeout_s
+        self._lock = threading.RLock()
         self._workers: list[str] = []
         self._rr = 0
         self._pending: list[VerificationRequest] = []      # no worker yet
         self._outstanding: dict[str, list[VerificationRequest]] = {}
-        self._dealt: dict[int, str] = {}                   # vid -> worker
+        self._dealt_at: dict[int, tuple[str, float]] = {}  # vid -> (worker, t)
+        self._last_activity: dict[str, float] = {}         # worker -> t
         network_service.add_message_handler(
             TopicSession(TOPIC_VERIFIER_REQUESTS), self._on_control)
 
@@ -82,54 +105,84 @@ class VerifierRequestQueue:
     def _on_control(self, msg) -> None:
         payload = deserialize(msg.data)
         if isinstance(payload, WorkerHello):
-            if payload.worker_address not in self._workers:
-                self._workers.append(payload.worker_address)
-                self._outstanding.setdefault(payload.worker_address, [])
+            with self._lock:
+                if payload.worker_address not in self._workers:
+                    self._workers.append(payload.worker_address)
+                    self._outstanding.setdefault(payload.worker_address, [])
+                self._last_activity[payload.worker_address] = time.monotonic()
             self._drain()
         elif isinstance(payload, WorkerGoodbye):
             self.detach_worker(payload.worker_address)
 
     def detach_worker(self, worker: str) -> None:
         """Worker death: requeue everything it held (broker redelivery)."""
-        if worker in self._workers:
-            self._workers.remove(worker)
-        held = self._outstanding.pop(worker, [])
-        for req in held:
-            self._dealt.pop(req.verification_id, None)
-        if held:
-            log.info("requeueing %d verifications from dead worker %s",
-                     len(held), worker)
-        self._pending = held + self._pending
+        with self._lock:
+            if worker in self._workers:
+                self._workers.remove(worker)
+            held = self._outstanding.pop(worker, [])
+            for req in held:
+                self._dealt_at.pop(req.verification_id, None)
+            if held:
+                log.info("requeueing %d verifications from dead worker %s",
+                         len(held), worker)
+            self._pending = held + self._pending
         self._drain()
+
+    def requeue_overdue(self) -> None:
+        """Declare dead any worker that is BOTH holding a request past the
+        redelivery timeout AND silent for that long — a busy worker that is
+        still acknowledging results (or re-Hello-ing) must not be flagged
+        while it works through a deep backlog (review r3). VerifierTests.kt
+        :73+ semantics for transports without liveness signals."""
+        if self.redelivery_timeout_s is None:
+            return
+        cutoff = time.monotonic() - self.redelivery_timeout_s
+        with self._lock:
+            overdue = {w for w, t in self._dealt_at.values()
+                       if t < cutoff
+                       and self._last_activity.get(w, 0.0) < cutoff}
+        for worker in overdue:
+            log.warning("verifier %s overdue past %.1fs with no activity; "
+                        "presuming dead", worker, self.redelivery_timeout_s)
+            self.detach_worker(worker)
 
     @property
     def worker_count(self) -> int:
-        return len(self._workers)
+        with self._lock:
+            return len(self._workers)
 
     # -- dispatch ------------------------------------------------------------
     def submit(self, request: VerificationRequest) -> None:
-        self._pending.append(request)
-        if not self._workers:
+        with self._lock:
+            self._pending.append(request)
+            no_worker = not self._workers
+        if no_worker:
             log.warning("verification request queued but no verifier is "
                         "attached (reference warns every 10s here)")
         self._drain()
 
     def acknowledge(self, verification_id: int) -> None:
         """Retire a completed request from its worker's outstanding list."""
-        worker = self._dealt.pop(verification_id, None)
-        if worker is None:
-            return
-        held = self._outstanding.get(worker, [])
-        self._outstanding[worker] = [r for r in held
-                                     if r.verification_id != verification_id]
+        with self._lock:
+            worker, _ = self._dealt_at.pop(verification_id, (None, 0.0))
+            if worker is None:
+                return
+            self._last_activity[worker] = time.monotonic()
+            held = self._outstanding.get(worker, [])
+            self._outstanding[worker] = [
+                r for r in held if r.verification_id != verification_id]
 
     def _drain(self) -> None:
-        while self._pending and self._workers:
-            req = self._pending.pop(0)
-            worker = self._workers[self._rr % len(self._workers)]
-            self._rr += 1
-            self._outstanding[worker].append(req)
-            self._dealt[req.verification_id] = worker
+        while True:
+            with self._lock:
+                if not self._pending or not self._workers:
+                    return
+                req = self._pending.pop(0)
+                worker = self._workers[self._rr % len(self._workers)]
+                self._rr += 1
+                self._outstanding[worker].append(req)
+                self._dealt_at[req.verification_id] = (worker,
+                                                       time.monotonic())
             self.network_service.send(TopicSession(TOPIC_VERIFIER_REQUESTS),
                                       serialize(req), worker)
 
@@ -139,27 +192,70 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
     (OutOfProcessTransactionVerifierService.kt:18-71: nonce → handle map,
     duration/success/failure/in-flight metrics, response consumer)."""
 
-    def __init__(self, network_service, metrics: MetricRegistry | None = None):
+    def __init__(self, network_service, metrics: MetricRegistry | None = None,
+                 redelivery_timeout_s: float | None = None):
         self.metrics = metrics if metrics is not None else MetricRegistry()
         self.network_service = network_service
-        self.queue = VerifierRequestQueue(network_service)
+        self.queue = VerifierRequestQueue(
+            network_service, redelivery_timeout_s=redelivery_timeout_s)
         self._ids = itertools.count(1)
         self._handles: dict[int, Future] = {}
         self._timers: dict[int, object] = {}
+        self._scanner = None
+        self._stopping = threading.Event()
         network_service.add_message_handler(
             TopicSession(TOPIC_VERIFIER_RESPONSES), self._on_response)
         self.metrics.gauge("Verification.InFlightOOP",
                            lambda: len(self._handles))
+        if redelivery_timeout_s is not None:
+            self._scanner = threading.Thread(
+                target=self._scan_overdue, daemon=True,
+                name="verifier-redelivery")
+            self._scanner.start()
+
+    def _scan_overdue(self) -> None:
+        while not self._stopping.wait(self.queue.redelivery_timeout_s / 2):
+            try:
+                self.queue.requeue_overdue()
+            except Exception:
+                log.exception("overdue-redelivery scan failed")
+
+    def shutdown(self) -> None:
+        self._stopping.set()
 
     def verify(self, ltx) -> Future:
-        vid = next(self._ids)
+        return self._submit(VerificationRequest(
+            next(self._ids), ltx, self.network_service.my_address))
+
+    def verify_signed(self, stx, services,
+                      check_sufficient_signatures: bool = True) -> Future:
+        """Full SignedTransaction verification with the signature EC math on
+        the WORKER's device batcher (SignedTransaction.verify semantics,
+        SignedTransaction.kt:174-178, shipped over the VerifierApi seam).
+        Coverage (missing-signer) checks are cheap and need the stx, so they
+        run node-side before dispatch; resolution happens node-side because
+        it needs the ServiceHub."""
+        if check_sufficient_signatures:
+            missing = stx.get_missing_signatures()
+            if missing:
+                from ..core.transactions.signed import (
+                    SignaturesMissingException)
+                fut: Future = Future()
+                fut.set_exception(SignaturesMissingException(
+                    missing, [k.to_string_short() for k in missing], stx.id))
+                return fut
+        ltx = stx.to_ledger_transaction(services)
+        sigs = tuple((sig.by, sig.bytes, stx.id.bytes) for sig in stx.sigs)
+        return self._submit(VerificationRequest(
+            next(self._ids), ltx, self.network_service.my_address, sigs))
+
+    def _submit(self, request: VerificationRequest) -> Future:
         fut: Future = Future()
-        self._handles[vid] = fut
+        self._handles[request.verification_id] = fut
         timer = self.metrics.timer("Verification.Duration")
         timer.__enter__()
-        self._timers[vid] = timer
-        self.queue.submit(VerificationRequest(
-            vid, ltx, self.network_service.my_address))
+        self._timers[request.verification_id] = timer
+        self.queue.submit(request)
         return fut
 
     def _on_response(self, msg) -> None:
@@ -184,29 +280,100 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
 class VerifierWorker:
     """The worker half (Verifier.kt:42-79): attach, consume, verify, reply.
     Stateless — run N of them against one queue; kill any mid-run and its
-    work redistributes."""
+    work redistributes.
 
-    def __init__(self, network_service, queue_address: str):
+    Device path (VERDICT r2 #1): requests carrying ``signatures`` run their
+    EC checks through this worker's ``SignatureBatcher`` — the message
+    handler only *submits* to the batcher and hands completion to a small
+    thread pool, so consecutive requests' signatures coalesce into one
+    device batch (cross-transaction batching inside the worker, the whole
+    point of putting a TPU behind the competing-consumer queue). Requests
+    without signatures keep the reference's synchronous host semantics
+    (deterministic for the manually-pumped test bus)."""
+
+    def __init__(self, network_service, queue_address: str,
+                 batcher=None, use_device: bool = True, pool_workers: int = 4,
+                 hello_interval_s: float | None = None):
         self.network_service = network_service
         self.queue_address = queue_address
         self.verified_count = 0
+        self._count_lock = threading.Lock()
+        self.use_device = use_device
+        self._batcher = batcher            # created lazily if None
+        self._pool = None
         self._registration = network_service.add_message_handler(
             TopicSession(TOPIC_VERIFIER_REQUESTS), self._on_request)
         self._alive = True
-        network_service.send(TopicSession(TOPIC_VERIFIER_REQUESTS),
-                             serialize(WorkerHello(network_service.my_address)),
-                             queue_address)
+        self._pool_workers = pool_workers
+        self._hello()
+        if hello_interval_s is not None:
+            # periodic re-attach (consumer keep-alive): a worker the queue
+            # presumed dead during a long device compile re-joins on the
+            # next Hello — attachment is idempotent on the queue side
+            def _rehello():
+                while self._alive:
+                    time.sleep(hello_interval_s)
+                    if self._alive:
+                        self._hello()
+            threading.Thread(target=_rehello, daemon=True,
+                             name="verifier-hello").start()
+
+    def _hello(self) -> None:
+        self.network_service.send(
+            TopicSession(TOPIC_VERIFIER_REQUESTS),
+            serialize(WorkerHello(self.network_service.my_address)),
+            self.queue_address)
+
+    @property
+    def batcher(self):
+        if self._batcher is None:
+            from .batcher import SignatureBatcher
+            self._batcher = SignatureBatcher(use_device=self.use_device)
+        return self._batcher
 
     def _on_request(self, msg) -> None:
         if not self._alive:
             return
         req: VerificationRequest = deserialize(msg.data)
-        error = None
+        if not req.signatures:
+            self._reply(req, self._verify_host(req))
+            return
+        # device path: queue the EC math now (non-blocking), finish async
+        sig_futures = self.batcher.submit_many(req.signatures)
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._pool_workers,
+                thread_name_prefix="verifier-worker")
+        self._pool.submit(self._complete_device, req, sig_futures)
+
+    def _verify_host(self, req: VerificationRequest) -> str | None:
         try:
             req.transaction.verify()
+            return None
+        except Exception as e:
+            return str(e)
+
+    def _complete_device(self, req: VerificationRequest,
+                         sig_futures: list) -> None:
+        error = None
+        try:
+            for (key, _sig, _content), fut in zip(req.signatures, sig_futures):
+                if not fut.result():
+                    error = (f"Signature by {key.to_string_short()} did not "
+                             f"verify")
+                    break
+            if error is None:
+                error = self._verify_host(req)
         except Exception as e:
             error = str(e)
-        self.verified_count += 1
+        self._reply(req, error)
+
+    def _reply(self, req: VerificationRequest, error: str | None) -> None:
+        if not self._alive:
+            return   # killed mid-verify: the node requeues our outstanding work
+        with self._count_lock:   # replies run on the completion pool's threads
+            self.verified_count += 1
         self.network_service.send(
             TopicSession(TOPIC_VERIFIER_RESPONSES),
             serialize(VerificationResponse(req.verification_id, error)),
@@ -222,3 +389,7 @@ class VerifierWorker:
                 TopicSession(TOPIC_VERIFIER_REQUESTS),
                 serialize(WorkerGoodbye(self.network_service.my_address)),
                 self.queue_address)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        if self._batcher is not None:
+            self._batcher.close()

@@ -75,10 +75,12 @@ class TpuTransactionVerifierService(TransactionVerifierService):
     """
 
     def __init__(self, workers: int = 4, batcher: SignatureBatcher | None = None,
-                 metrics: MetricRegistry | None = None):
+                 metrics: MetricRegistry | None = None, mesh=None):
         self.metrics = metrics if metrics is not None else MetricRegistry()
+        # mesh: shard every device batch over the local chips (the node's
+        # whole slice verifies as one SPMD program; corda_tpu.parallel)
         self.batcher = batcher if batcher is not None else SignatureBatcher(
-            metrics=self.metrics)
+            metrics=self.metrics, mesh=mesh)
         self._pool = ThreadPoolExecutor(max_workers=workers,
                                         thread_name_prefix="tpu-verifier")
 
@@ -87,9 +89,8 @@ class TpuTransactionVerifierService(TransactionVerifierService):
                       check_sufficient_signatures: bool = True) -> Future:
         """Async full verify of a SignedTransaction; the per-signature EC math
         rides the shared device batcher (cross-transaction batching)."""
-        sig_futures = [
-            (sig, self.batcher.submit(sig.by, sig.bytes, stx.id.bytes))
-            for sig in stx.sigs]
+        sig_futures = list(zip(stx.sigs, self.batcher.submit_many(
+            [(sig.by, sig.bytes, stx.id.bytes) for sig in stx.sigs])))
 
         def work():
             for sig, fut in sig_futures:

@@ -1,0 +1,89 @@
+"""SignatureBatcher policy tests: host-crossover routing, per-item fault
+isolation, bulk submission (VERDICT r2 #1b/c, weak #9).
+
+Reference analog: the verifier thread-pool seam
+(InMemoryTransactionVerifierService.kt:10-18) — here the policy layer in
+front of the device kernels.
+"""
+import pytest
+
+from corda_tpu.core.crypto import generate_keypair
+from corda_tpu.core.crypto.keys import PublicKey
+from corda_tpu.core.crypto.schemes import ECDSA_SECP256K1_SHA256
+from corda_tpu.core.crypto.signatures import Crypto
+from corda_tpu.verifier.batcher import SignatureBatcher
+
+KP = generate_keypair(ECDSA_SECP256K1_SHA256, entropy=b"\x61" * 32)
+CONTENT = b"batcher policy test content"
+SIG = Crypto.sign_with_key(KP, CONTENT).bytes
+
+
+def test_small_batches_route_to_host():
+    """Below the crossover the device dispatch floor (~140 ms) dwarfs host
+    verification — small batches must run on host, and without the linger
+    wait (the p50@batch=1 path)."""
+    b = SignatureBatcher(host_crossover=64)
+    try:
+        futs = [b.submit(KP.public, SIG, CONTENT) for _ in range(3)]
+        assert all(f.result(timeout=30) for f in futs)
+        snap = b.metrics.snapshot()
+        assert snap["SigBatcher.HostRouted"]["count"] == 3
+        assert "SigBatcher.DeviceBatches" not in snap
+    finally:
+        b.close()
+
+
+def test_crossover_zero_forces_device():
+    b = SignatureBatcher(host_crossover=0, max_latency_s=0.01)
+    try:
+        futs = b.submit_many([(KP.public, SIG, CONTENT)] * 4)
+        assert all(f.result(timeout=120) for f in futs)
+        snap = b.metrics.snapshot()
+        assert snap["SigBatcher.DeviceBatches"]["count"] >= 1
+        assert snap["SigBatcher.DeviceChecked"]["count"] >= 4
+    finally:
+        b.close()
+
+
+def test_malformed_member_does_not_poison_batch():
+    """Weak #9: one malformed item (garbage key encoding / truncated DER)
+    becomes a False verdict for that item alone — siblings still verify."""
+    garbage_key = PublicKey(ECDSA_SECP256K1_SHA256, b"\xff" * 33)
+    b = SignatureBatcher(host_crossover=0, max_latency_s=0.01)
+    try:
+        futs = b.submit_many([
+            (KP.public, SIG, CONTENT),
+            (garbage_key, SIG, CONTENT),          # undecodable point
+            (KP.public, b"\x00\x01", CONTENT),     # truncated DER
+            (KP.public, SIG, CONTENT),
+        ])
+        results = [f.result(timeout=120) for f in futs]
+        assert results == [True, False, False, True]
+    finally:
+        b.close()
+
+
+def test_p50_batch1_latency_skips_linger():
+    """A lone submit must not pay max_latency_s linger: with the crossover
+    active it dispatches immediately to host. Generous bound (CI boxes)."""
+    import time
+    b = SignatureBatcher(host_crossover=64, max_latency_s=0.5)
+    try:
+        b.submit(KP.public, SIG, CONTENT).result(timeout=30)  # warm path
+        t0 = time.perf_counter()
+        assert b.submit(KP.public, SIG, CONTENT).result(timeout=30)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.4, f"lone submit lingered: {elapsed:.3f}s"
+    finally:
+        b.close()
+
+
+def test_bulk_submit_verdicts_match_individual():
+    wrong = Crypto.sign_with_key(KP, b"other").bytes
+    b = SignatureBatcher(host_crossover=64)
+    try:
+        futs = b.submit_many([(KP.public, SIG, CONTENT),
+                              (KP.public, wrong, CONTENT)])
+        assert [f.result(timeout=30) for f in futs] == [True, False]
+    finally:
+        b.close()

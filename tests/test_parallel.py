@@ -98,3 +98,34 @@ def test_tx_verify_step(mesh):
                   for i in range(0, len(hs), 2)]
         return hs[0]
     assert sha_ops.digests_to_bytes(np.asarray(root)[None])[0] == host_root(leaves_bytes)
+
+
+def test_mesh_backed_batcher_matches_host(mesh):
+    """VERDICT r2 #7: the SERVICE seam composed with the mesh — a
+    SignatureBatcher(mesh=...) shards its device batches over every chip
+    and returns the same verdicts as host verification."""
+    from corda_tpu.core.crypto import generate_keypair
+    from corda_tpu.core.crypto.schemes import (ECDSA_SECP256K1_SHA256,
+                                               EDDSA_ED25519_SHA512)
+    from corda_tpu.core.crypto.signatures import Crypto
+    from corda_tpu.verifier.batcher import SignatureBatcher
+
+    checks, want = [], []
+    for i in range(12):
+        scheme = (EDDSA_ED25519_SHA512 if i % 2 else ECDSA_SECP256K1_SHA256)
+        kp = generate_keypair(scheme, entropy=bytes([0x30 + i]) * 32)
+        content = bytes([i]) * 24
+        sig = Crypto.sign_with_key(kp, content).bytes
+        if i % 4 == 2:
+            content = content + b"!"        # invalidate
+        checks.append((kp.public, sig, content))
+        want.append(Crypto.is_valid(kp.public, sig, content))
+    b = SignatureBatcher(mesh=mesh, host_crossover=0, max_latency_s=0.02)
+    try:
+        futs = b.submit_many(checks)
+        got = [f.result(timeout=300) for f in futs]
+        assert got == want
+        snap = b.metrics.snapshot()
+        assert snap["SigBatcher.DeviceChecked"]["count"] >= len(checks)
+    finally:
+        b.close()
