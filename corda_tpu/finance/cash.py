@@ -24,6 +24,12 @@ from ..core.contracts.structures import (Command, CommandData, Contract,
 from ..core.crypto.keys import PublicKey
 from ..core.crypto.secure_hash import SecureHash
 from ..core.serialization import serializable
+from ..node.schemas import MappedSchema
+
+#: The reference's CashSchemaV1 (finance/schemas/CashSchemaV1.kt): the
+#: exportable typed projection of cash states.
+CASH_SCHEMA_V1 = MappedSchema("CashSchema", 1, (
+    "owner_key", "pennies", "ccy_code", "issuer_party", "issuer_ref"))
 
 
 # ---------------------------------------------------------------------------
@@ -78,6 +84,21 @@ class CashState(FungibleAsset):
 
     def with_new_owner(self, new_owner: PublicKey):
         return (Move(), CashState(self.amount, new_owner))
+
+    # -- custom schema export (finance CashSchemaV1 analog) ------------------
+    def supported_schemas(self) -> tuple:
+        return (CASH_SCHEMA_V1,)
+
+    def generate_mapped_object(self, schema) -> dict:
+        if schema.table_name != CASH_SCHEMA_V1.table_name:
+            raise ValueError(f"unsupported schema {schema.name}")
+        return {
+            "owner_key": self.owner.to_string_short(),
+            "pennies": self.amount.quantity,
+            "ccy_code": str(self.amount.token.product),
+            "issuer_party": str(self.issuer.party.name),
+            "issuer_ref": self.issuer.reference.hex(),
+        }
 
 
 # ---------------------------------------------------------------------------

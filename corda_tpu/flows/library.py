@@ -413,6 +413,10 @@ class CollectSignaturesFlow(FlowLogic):
         for key in stx.tx.must_sign:
             if key == notary_key or any(k in our_keys for k in key.keys):
                 continue
+            # a signature already attached (e.g. an oracle's tear-off
+            # signature collected before this flow) is not re-requested
+            if key.is_fulfilled_by({s.by for s in stx.sigs}):
+                continue
             party = _party_by_key(hub, key)
             if party is None:
                 raise FlowException(

@@ -211,12 +211,27 @@ class NetworkMapCache:
 
     def __init__(self):
         self._nodes: dict[str, NodeInfo] = {}
+        self._observers: list = []    # cb(("added"|"removed", NodeInfo))
 
     def add_node(self, info: NodeInfo) -> None:
         self._nodes[str(info.legal_identity.name)] = info
+        self._emit(("added", info))
 
     def remove_node(self, name: str) -> None:
-        self._nodes.pop(name, None)
+        info = self._nodes.pop(name, None)
+        if info is not None:
+            self._emit(("removed", info))
+
+    def add_change_observer(self, cb) -> None:
+        """networkMapFeed's MapChange stream (NetworkMapCache.kt:1-134)."""
+        self._observers.append(cb)
+
+    def _emit(self, change) -> None:
+        for cb in list(self._observers):
+            try:
+                cb(change)
+            except Exception:
+                pass
 
     def get_node_by_legal_name(self, name: str) -> NodeInfo | None:
         return self._nodes.get(str(name))
@@ -256,6 +271,10 @@ class ServiceHub:
         self.smm = None  # set by the node after SMM construction
         from .vault import NodeVaultService
         self.vault = NodeVaultService(self)
+        # typed projections of vault states into custom schema tables
+        # (NodeSchemaService + HibernateObserver role; node/schemas.py)
+        from .schemas import SchemaService
+        self.schema_service = SchemaService(self).start()
 
     # -- identity / directory -----------------------------------------------
     def well_known_party(self, name) -> Party | None:
@@ -281,6 +300,12 @@ class ServiceHub:
             self.vault.notify_all(fresh)
             for stx in fresh:
                 self.storage.notify_listeners(stx)
+            # flow → transaction mapping for the RPC mapping feed
+            # (StateMachineRecordedTransactionMapping)
+            smm = getattr(self, "smm", None)
+            if smm is not None and smm.current_fsm is not None:
+                for stx in fresh:
+                    smm.record_tx_mapping(smm.current_fsm.run_id, stx.id)
 
     # -- signing -------------------------------------------------------------
     def sign(self, content: bytes, key: PublicKey | None = None
