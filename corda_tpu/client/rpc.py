@@ -3,12 +3,18 @@
 Reference parity: client/rpc CordaRPCClient → proxy of CordaRPCOps
 (RPCClient.kt / RPCClientProxyHandler.kt): the client opens its own transport
 endpoint, sends framed requests carrying a reply address, correlates
-responses by request id, and surfaces server-side exceptions. Flow results
-are polled (`start_flow_and_wait`) — the reference's observable stream demux
-maps to the feed/snapshot split on this wire.
+responses by request id, and surfaces server-side exceptions.
+
+Observable streaming (RPCClientProxyHandler.kt:1-421 / RPCApi.kt:27-60):
+a server method returning a feed comes back as a FeedHandle (server-assigned
+feed id + snapshot); subsequent observations are PUSHED to this client's
+address and demuxed by id into ``ClientDataFeed`` callbacks/queues — no
+polling. ``start_flow_and_wait`` rides a tracked-flow feed: progress steps
+and the terminal result arrive as pushes.
 """
 from __future__ import annotations
 
+import queue as _queue
 import threading
 import time
 import uuid
@@ -16,7 +22,8 @@ import uuid
 from ..core.serialization import deserialize, serialize
 from ..network.messaging import TopicSession
 from ..network.tcp import TcpMessagingService
-from ..node.node import TOPIC_RPC, RpcRequest, RpcResponse
+from ..node.node import (TOPIC_RPC, FeedHandle, Observation, RpcRequest,
+                         RpcResponse)
 
 
 class RPCException(Exception):
@@ -25,6 +32,40 @@ class RPCException(Exception):
 
 class FlowFailedException(RPCException):
     pass
+
+
+class ClientDataFeed:
+    """Client half of a streamed feed: snapshot + pushed observations
+    (demuxed by the server-assigned feed id)."""
+
+    def __init__(self, client: "CordaRPCClient", feed_id: str, snapshot):
+        self._client = client
+        self.feed_id = feed_id
+        self.snapshot = snapshot
+        self.events: "_queue.Queue" = _queue.Queue()
+        self._callbacks: list = []
+
+    def subscribe(self, callback) -> None:
+        self._callbacks.append(callback)
+
+    def next_event(self, timeout_s: float = 30.0):
+        """Block for the next pushed observation."""
+        try:
+            return self.events.get(timeout=timeout_s)
+        except _queue.Empty:
+            raise RPCException(
+                f"no observation on feed {self.feed_id} in {timeout_s}s")
+
+    def _on_observation(self, payload) -> None:
+        self.events.put(payload)
+        for cb in list(self._callbacks):
+            try:
+                cb(payload)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._client._close_feed(self)
 
 
 class CordaRPCClient:
@@ -48,6 +89,10 @@ class CordaRPCClient:
             name, client_host, 0, lambda name: self.node_addr, tls=tls)
         self._messaging.add_message_handler(TopicSession(TOPIC_RPC, 1),
                                             self._on_response)
+        self._feeds: dict[str, ClientDataFeed] = {}
+        self._orphan_observations: dict[str, list] = {}
+        self._messaging.add_message_handler(TopicSession(TOPIC_RPC, 2),
+                                            self._on_observation)
         self.reply_to = f"{client_host}:{self._messaging.port}"
 
     # -- plumbing ------------------------------------------------------------
@@ -56,6 +101,19 @@ class CordaRPCClient:
         with self._cond:
             self._pending[resp.request_id] = resp
             self._cond.notify_all()
+
+    def _on_observation(self, msg) -> None:
+        obs: Observation = deserialize(msg.data)
+        with self._cond:
+            feed = self._feeds.get(obs.feed_id)
+            if feed is None or obs.feed_id in self._orphan_observations:
+                # observation raced ahead of the FeedHandle response (or a
+                # replay of earlier parked observations is still running) —
+                # park it so delivery order matches push order
+                self._orphan_observations.setdefault(
+                    obs.feed_id, []).append(obs.payload)
+                return
+        feed._on_observation(obs.payload)
 
     def call(self, method: str, *args):
         rid = uuid.uuid4().hex
@@ -71,7 +129,31 @@ class CordaRPCClient:
             resp = self._pending.pop(rid)
         if resp.error is not None:
             raise RPCException(resp.error)
+        if isinstance(resp.result, FeedHandle):
+            feed = ClientDataFeed(self, resp.result.feed_id,
+                                  resp.result.snapshot)
+            with self._cond:
+                self._feeds[feed.feed_id] = feed
+                had_orphans = feed.feed_id in self._orphan_observations
+            # replay parked observations IN ORDER: new pushes keep parking
+            # behind them (see _on_observation) until the list drains empty
+            while had_orphans:
+                with self._cond:
+                    parked = self._orphan_observations.get(feed.feed_id, [])
+                    if not parked:
+                        self._orphan_observations.pop(feed.feed_id, None)
+                        break
+                    payload = parked.pop(0)
+                feed._on_observation(payload)
+            return feed
         return resp.result
+
+    def _close_feed(self, feed: ClientDataFeed) -> None:
+        self._feeds.pop(feed.feed_id, None)
+        try:
+            self.call("unsubscribe_feed", feed.feed_id)
+        except RPCException:
+            pass
 
     # -- the proxy surface ---------------------------------------------------
     def start_flow(self, flow_name: str, *args) -> str:
@@ -80,8 +162,38 @@ class CordaRPCClient:
     def flow_result(self, run_id: str):
         return self.call("flow_result", run_id)
 
+    def start_tracked_flow(self, flow_name: str, *args) -> ClientDataFeed:
+        """startTrackedFlowDynamic: the returned feed's snapshot is the run
+        id; pushed events are ("progress", step) and the terminal
+        ("removed", [status, value])."""
+        return self.call("start_flow_tracked", flow_name, *args)
+
     def start_flow_and_wait(self, flow_name: str, *args,
                             timeout_s: float = 60.0, poll_s: float = 0.2):
+        """Start a flow and wait for its result — PUSHED over the tracked
+        feed (no polling); falls back to result polling against servers
+        without the streaming protocol."""
+        try:
+            feed = self.start_tracked_flow(flow_name, *args)
+        except RPCException:
+            feed = None
+        if isinstance(feed, ClientDataFeed):
+            deadline = time.monotonic() + timeout_s
+            try:
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise RPCException(
+                            f"flow {feed.snapshot} did not finish in "
+                            f"{timeout_s}s")
+                    event = feed.next_event(timeout_s=remaining)
+                    if event[0] == "removed":
+                        status, value = event[1]
+                        if status == "failed":
+                            raise FlowFailedException(value)
+                        return value
+            finally:
+                feed.close()
         run_id = self.start_flow(flow_name, *args)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
@@ -99,4 +211,9 @@ class CordaRPCClient:
         return lambda *args: self.call(name, *args)
 
     def close(self) -> None:
+        for feed in list(self._feeds.values()):
+            try:
+                feed.close()
+            except Exception:
+                pass
         self._messaging.stop()

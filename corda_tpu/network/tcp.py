@@ -61,7 +61,11 @@ class TcpMessagingService(MessagingService):
             f"node-thread({my_name})")
         self._handlers = HandlerTable()
         self._undelivered: list[Message] = []
+        # called (on executor) with the recipient name after a send is
+        # abandoned — lets the RPC server drop dead clients' subscriptions
+        self.on_send_failure: Callable[[str], None] | None = None
         self._writers: dict[str, asyncio.StreamWriter] = {}
+        self._inbound: set[asyncio.StreamWriter] = set()
         self._send_queues: dict[str, "asyncio.Queue"] = {}
         self._sender_tasks: dict[str, "asyncio.Task"] = {}
         self._stopping = False
@@ -93,6 +97,7 @@ class TcpMessagingService(MessagingService):
         # — it overrides whatever sender the frame body claims, so consumers
         # of Message.sender (e.g. BFT state-transfer vote tallies) see a
         # transport-authenticated name, not an attacker-chosen string
+        self._inbound.add(writer)   # closed on stop() so peers see EOF
         cert_cn = None
         if self.tls is not None:
             from .tls import peer_common_name
@@ -121,6 +126,7 @@ class TcpMessagingService(MessagingService):
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         finally:
+            self._inbound.discard(writer)
             writer.close()
 
     # -- inbound dispatch ----------------------------------------------------
@@ -183,6 +189,9 @@ class TcpMessagingService(MessagingService):
                     self._writers.pop(recipient, None)
                     if attempt == MAX_SEND_ATTEMPTS - 1:
                         log.error("giving up sending to %s: %s", recipient, e)
+                        hook = self.on_send_failure
+                        if hook is not None:
+                            self.executor.execute(lambda: hook(recipient))
                         break
                     await asyncio.sleep(REDELIVERY_DELAY_S)
 
@@ -194,10 +203,51 @@ class TcpMessagingService(MessagingService):
         if addr is None:
             raise LookupError(f"no address known for {recipient!r}")
         host, port = addr
-        _, writer = await asyncio.open_connection(
+        reader, writer = await asyncio.open_connection(
             host, port, ssl=self.tls.client_ctx if self.tls is not None else None)
         self._writers[recipient] = writer
+        # outbound connections are write-only in this protocol, so a read
+        # completing means the peer closed; writes into a half-closed socket
+        # "succeed" into the kernel buffer, which would leave dead peers
+        # (e.g. crashed RPC clients holding feed subscriptions) undetected
+        self._loop.create_task(
+            self._watch_connection(recipient, reader, writer))
         return writer
+
+    async def _watch_connection(self, recipient: str,
+                                reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        try:
+            await reader.read()          # EOF or reset = peer gone
+        except Exception:
+            pass
+        if self._stopping:
+            return
+        # retire OUR writer only — the send retry loop may have already
+        # replaced it with a fresh healthy connection — and close it so the
+        # EOF'd socket doesn't linger in CLOSE_WAIT
+        if self._writers.get(recipient) is writer:
+            self._writers.pop(recipient, None)
+        writer.close()
+        # liveness probe: a transient drop reconnects; refusal means the
+        # peer process is dead → surface to on_send_failure (feed cleanup)
+        await asyncio.sleep(0.2)
+        addr = self.resolve_address(recipient)
+        probe_failed = True
+        if addr is not None:
+            try:
+                _, probe = await asyncio.open_connection(
+                    addr[0], addr[1],
+                    ssl=self.tls.client_ctx if self.tls is not None else None)
+                probe.close()
+                probe_failed = False
+            except Exception:
+                pass
+        if probe_failed:
+            log.info("peer %s disconnected and is unreachable", recipient)
+            hook = self.on_send_failure
+            if hook is not None:
+                self.executor.execute(lambda: hook(recipient))
 
     def add_message_handler(self, topic_session: TopicSession, callback
                             ) -> MessageHandlerRegistration:
@@ -227,8 +277,16 @@ class TcpMessagingService(MessagingService):
                 task.cancel()
             # await the cancellations so the loop retires them cleanly
             await asyncio.gather(*tasks, return_exceptions=True)
-            for w in self._writers.values():
+            # close inbound connections too: a stopped endpoint must look
+            # DEAD to its peers (EOF fires their connection watchers), not
+            # like a zombie holding sockets open. The close must FLUSH (FIN
+            # actually sent) before the loop stops, hence wait_closed.
+            closing = list(self._writers.values()) + list(self._inbound)
+            for w in closing:
                 w.close()
+            await asyncio.wait_for(
+                asyncio.gather(*(w.wait_closed() for w in closing),
+                               return_exceptions=True), timeout=2.0)
             if self._server is not None:
                 self._server.close()
             self._loop.stop()

@@ -83,10 +83,29 @@ class RpcResponse:
     error: str | None = None
 
 
+@dataclass(frozen=True)
+class FeedHandle:
+    """A server-assigned observable id + the snapshot (the reference
+    serializes Observables as ids on the RPC wire, RPCApi.kt:27-60)."""
+
+    feed_id: str
+    snapshot: object
+
+
+@dataclass(frozen=True)
+class Observation:
+    """One pushed observation for a subscribed feed (RPCApi Observation)."""
+
+    feed_id: str
+    payload: object
+
+
 register_type("rpc.RpcRequest", RpcRequest,
               to_fields=lambda r: [r.request_id, r.method, list(r.args), r.reply_to],
               from_fields=lambda f: RpcRequest(f[0], f[1], list(f[2]), f[3]))
 register_type("rpc.RpcResponse", RpcResponse)
+register_type("rpc.FeedHandle", FeedHandle)
+register_type("rpc.Observation", Observation)
 
 
 class Node:
@@ -134,6 +153,13 @@ class Node:
         self.notary_service = self._make_notary()
         self.rpc_ops = CordaRPCOps(self.services, self.smm)
         self._rpc_flows: dict[str, object] = {}
+        # observable streaming (RPCServer.kt + RPCApi.kt:27-60): feed_id →
+        # (client address, alive flag); per-client index for disconnect
+        # cleanup — a client whose address stops accepting frames has every
+        # feed dropped (the artemis binding-removal cleanup analog)
+        self._feeds: dict[str, tuple[str, dict]] = {}
+        self._client_feeds: dict[str, set] = {}
+        self.messaging.on_send_failure = self._on_client_unreachable
         self.network_map_service = None
         self.network_map_client = None
 
@@ -251,7 +277,57 @@ class Node:
         self.messaging.send(TopicSession(TOPIC_RPC, 1), resp_bytes,
                             req.reply_to)
 
+    # -- observable streaming ------------------------------------------------
+    def _register_feed(self, feed, client_addr: str) -> FeedHandle:
+        """Turn a DataFeed into a server-held subscription that pushes each
+        observation to the client's address; the wire sees only the id +
+        snapshot (the reference's observable-as-id serialization)."""
+        feed_id = uuid.uuid4().hex
+        alive = {"on": True}
+        self._feeds[feed_id] = (client_addr, alive)
+        self._client_feeds.setdefault(client_addr, set()).add(feed_id)
+
+        def push(update):
+            if not alive["on"]:
+                return
+            try:
+                payload = serialize(Observation(feed_id, update))
+            except Exception as e:
+                try:
+                    payload = serialize(Observation(
+                        feed_id, ("error", f"unserializable update: {e}")))
+                except Exception:
+                    return
+            self.messaging.send(TopicSession(TOPIC_RPC, 2), payload,
+                                client_addr)
+
+        feed.subscribe(push)
+        return FeedHandle(feed_id, feed.snapshot)
+
+    def _unsubscribe_feed(self, feed_id: str) -> None:
+        entry = self._feeds.pop(feed_id, None)
+        if entry is not None:
+            client_addr, alive = entry
+            alive["on"] = False
+            self._client_feeds.get(client_addr, set()).discard(feed_id)
+
+    def _on_client_unreachable(self, recipient: str) -> None:
+        """Transport gave up on this address: drop all its feeds so dead
+        clients do not leak subscriptions (disconnect cleanup)."""
+        for feed_id in list(self._client_feeds.get(recipient, ())):
+            self._unsubscribe_feed(feed_id)
+        self._client_feeds.pop(recipient, None)
+
     def _dispatch_rpc(self, req: RpcRequest):
+        if req.method == "unsubscribe_feed":
+            self._unsubscribe_feed(req.args[0])
+            return None
+        if req.method == "start_flow_tracked":
+            flow_name, args = req.args[0], req.args[1:]
+            fsm, feed = self.rpc_ops.start_tracked_flow_dynamic(
+                flow_name, *args)
+            self._rpc_flows[fsm.run_id] = fsm
+            return self._register_feed(feed, req.reply_to)
         if req.method == "start_flow":
             flow_name, args = req.args[0], req.args[1:]
             fsm = self.rpc_ops.start_flow_dynamic(flow_name, *args)
@@ -270,7 +346,12 @@ class Node:
         method = getattr(self.rpc_ops, req.method, None)
         if method is None or req.method.startswith("_"):
             raise AttributeError(f"no such RPC op: {req.method}")
-        return method(*req.args)
+        result = method(*req.args)
+        from .rpc import DataFeed
+        if isinstance(result, DataFeed):
+            # feeds cross the wire as id + snapshot; observations are pushed
+            return self._register_feed(result, req.reply_to)
+        return result
 
 
 _PLACEHOLDER_KEY = generate_keypair(entropy=b"\x00" * 32).public

@@ -120,6 +120,56 @@ class CordaRPCOps:
                     fsm.run_id, flow_name(type(fsm.flow)), fsm.done))))
         return DataFeed(self.state_machines_snapshot(), subscribe)
 
+    def start_tracked_flow_dynamic(self, flow_class_or_name, *args, **kwargs):
+        """startTrackedFlowDynamic (CordaRPCOps.kt:209): starts the flow AND
+        returns (fsm, DataFeed) whose updates stream progress-tracker steps
+        and the terminal ("removed", result-or-error) event."""
+        subscribers: list = []
+        buffered: list = []   # a fast flow can finish before anyone subscribes
+
+        def emit(update):
+            if not subscribers:
+                buffered.append(update)
+                return
+            for cb in list(subscribers):
+                try:
+                    cb(update)
+                except Exception:
+                    pass
+
+        def subscribe(cb):
+            subscribers.append(cb)
+            while buffered:
+                cb(buffered.pop(0))
+
+        fsm = self.start_flow_dynamic(flow_class_or_name, *args, **kwargs)
+        tracker = getattr(fsm.flow, "progress_tracker", None)
+        if tracker is not None:
+            tracker.subscribe(
+                lambda ev: emit(("progress", str(ev[2])))
+                if ev[0] == "position" else None)
+
+        def on_done(fut):
+            try:
+                emit(("removed", ["done", fut.result()]))
+            except Exception as e:
+                emit(("removed", ["failed", f"{type(e).__name__}: {e}"]))
+
+        fsm.result_future.add_done_callback(on_done)
+        return fsm, DataFeed(fsm.run_id, subscribe)
+
+    def state_machine_recorded_transaction_mapping_snapshot(self) -> list:
+        """stateMachineRecordedTransactionMapping (CordaRPCOps.kt:184-187):
+        which flow recorded which transaction."""
+        return [list(m) for m in self.smm.tx_mappings]
+
+    def state_machine_recorded_transaction_mapping_feed(self) -> DataFeed:
+        def subscribe(cb):
+            self.smm.add_mapping_observer(lambda m: cb(list(m)))
+        return DataFeed(
+            self.state_machine_recorded_transaction_mapping_snapshot(),
+            subscribe)
+
     # -- ledger --------------------------------------------------------------
     def verified_transactions_snapshot(self) -> list:
         return self.hub.storage.transactions
@@ -128,6 +178,18 @@ class CordaRPCOps:
         def subscribe(cb):
             self.hub.storage.add_commit_listener(cb)
         return DataFeed(self.hub.storage.transactions, subscribe)
+
+    def network_map_feed(self) -> DataFeed:
+        """networkMapFeed (CordaRPCOps.kt:193): snapshot + MapChange pushes."""
+        def subscribe(cb):
+            self.hub.network_map_cache.add_change_observer(cb)
+        return DataFeed(self.network_map_snapshot(), subscribe)
+
+    def wait_until_registered_with_network_map(self) -> bool:
+        """waitUntilRegisteredWithNetworkMap (CordaRPCOps.kt:275) — here a
+        non-blocking registration probe (the remote client polls it)."""
+        return len(self.hub.network_map_cache.all_nodes()) > 1 or \
+            self.hub.my_info in self.hub.network_map_cache.all_nodes()
 
     # -- vault ---------------------------------------------------------------
     def vault_snapshot(self, state_type: type | None = None) -> list:
@@ -153,6 +215,35 @@ class CordaRPCOps:
             self.hub.vault.add_update_observer(cb)
         return DataFeed(self.vault_snapshot(state_type), subscribe)
 
+    def vault_track_by(self, criteria=None, paging=None, sorting=None
+                       ) -> DataFeed:
+        """vaultTrackBy (CordaRPCOps.kt:137-156): criteria-filtered page
+        snapshot + the vault update stream."""
+        def subscribe(cb):
+            self.hub.vault.add_update_observer(cb)
+        return DataFeed(
+            self.hub.vault.query_by(criteria, paging=paging, sorting=sorting),
+            subscribe)
+
+    def add_vault_transaction_note(self, tx_id, note: str) -> None:
+        self.hub.vault.add_transaction_note(tx_id, note)
+
+    def get_vault_transaction_notes(self, tx_id) -> list[str]:
+        return self.hub.vault.get_transaction_notes(tx_id)
+
+    def get_cash_balances(self) -> dict:
+        """getCashBalances (CordaRPCOps.kt:230): unconsumed fungible-asset
+        quantities summed per product (currency code)."""
+        balances: dict = {}
+        for sar in self.hub.vault.unconsumed_states():
+            amount = getattr(sar.state.data, "amount", None)
+            if amount is None:
+                continue
+            product = getattr(amount.token, "product", amount.token)
+            key = str(product)
+            balances[key] = balances.get(key, 0) + amount.quantity
+        return balances
+
     # -- attachments ---------------------------------------------------------
     def upload_attachment(self, data: bytes):
         return self.hub.attachments.import_attachment(data)
@@ -162,6 +253,29 @@ class CordaRPCOps:
 
     def attachment_exists(self, att_id) -> bool:
         return self.hub.attachments.has_attachment(att_id)
+
+    def upload_file(self, data_type: str, name: str | None,
+                    data: bytes) -> str:
+        """uploadFile (CordaRPCOps.kt:249): typed upload dispatch — files of
+        type "attachment" land in attachment storage; other types go to any
+        registered acceptor (the interest-rates-oracle fixes upload path)."""
+        if data_type == "attachment":
+            return str(self.hub.attachments.import_attachment(data))
+        acceptor = getattr(self.hub, "file_uploaders", {}).get(data_type)
+        if acceptor is None:
+            raise ValueError(f"no acceptor for file type {data_type!r}")
+        return acceptor(name, data)
+
+    # -- contract upgrade authorisation --------------------------------------
+    def authorise_contract_upgrade(self, state_and_ref,
+                                   upgraded_contract_name: str) -> None:
+        from ..flows.contract_upgrade import authorise_contract_upgrade
+        authorise_contract_upgrade(self.hub, state_and_ref,
+                                   upgraded_contract_name)
+
+    def deauthorise_contract_upgrade(self, state_and_ref) -> None:
+        from ..flows.contract_upgrade import deauthorise_contract_upgrade
+        deauthorise_contract_upgrade(self.hub, state_and_ref)
 
     # -- identity ------------------------------------------------------------
     def party_from_key(self, key):
@@ -177,3 +291,17 @@ class CordaRPCOps:
             if (exact and query == name) or (not exact and query in name):
                 out.add(info.legal_identity)
         return out
+
+    def party_from_name(self, name: str):
+        """partyFromName (CordaRPCOps.kt:288): unique substring match."""
+        matches = self.parties_from_name(name, exact=False)
+        return next(iter(matches)) if len(matches) == 1 else None
+
+    def node_identity_from_party(self, party):
+        """nodeIdentityFromParty (CordaRPCOps.kt:313)."""
+        for info in self.hub.network_map_cache.all_nodes():
+            if info.legal_identity == party or \
+                    info.legal_identity.owning_key == getattr(
+                        party, "owning_key", None):
+                return info
+        return None

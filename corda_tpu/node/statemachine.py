@@ -125,6 +125,24 @@ class StateMachineManager:
         # only on the notary node); falls back to the global @initiated_by
         # registry — AbstractNode.registerInitiatedFlows / installCoreFlows.
         self.flow_factories: dict[str, Any] = {}
+        # flow → recorded-transaction mapping (the reference's
+        # stateMachineRecordedTransactionMappingFeed source): the hub calls
+        # record_tx_mapping while current_fsm identifies the recording flow
+        self.current_fsm: FlowStateMachine | None = None
+        self.tx_mappings: list[tuple[str, Any]] = []   # (run_id, tx_id)
+        self._mapping_observers: list = []
+
+    def record_tx_mapping(self, run_id: str, tx_id) -> None:
+        mapping = (run_id, tx_id)
+        self.tx_mappings.append(mapping)
+        for cb in list(self._mapping_observers):
+            try:
+                cb(mapping)
+            except Exception:
+                pass
+
+    def add_mapping_observer(self, cb) -> None:
+        self._mapping_observers.append(cb)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> None:
@@ -188,6 +206,16 @@ class StateMachineManager:
                  ) -> None:
         """Run the generator until it parks or finishes. Each iteration feeds
         the previous response and receives the next FlowIORequest."""
+        previous = self.current_fsm
+        self.current_fsm = fsm   # attribute hub.record_transactions to us
+        try:
+            self._advance_inner(fsm, first, resume_value, resume_error)
+        finally:
+            self.current_fsm = previous
+
+    def _advance_inner(self, fsm: FlowStateMachine, first: bool = False,
+                       resume_value: Any = None,
+                       resume_error: Exception | None = None) -> None:
         if fsm.generator is None or fsm.done:
             return
         gen = fsm.generator

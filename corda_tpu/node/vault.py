@@ -36,6 +36,14 @@ class VaultUpdate:
         return not self.consumed and not self.produced
 
 
+from ..core.serialization import register_type as _register_type  # noqa: E402
+
+# vault updates cross the RPC wire as pushed feed observations
+_register_type("vault.VaultUpdate", VaultUpdate,
+               to_fields=lambda u: [list(u.consumed), list(u.produced)],
+               from_fields=lambda f: VaultUpdate(tuple(f[0]), tuple(f[1])))
+
+
 class SoftLockError(Exception):
     pass
 
@@ -51,6 +59,16 @@ class NodeVaultService:
         self._consumed_time: dict[StateRef, _dt.datetime] = {}
         self._soft_locks: dict[StateRef, str] = {}      # ref -> lock id (flow id)
         self._observers: list = []
+        self._tx_notes: dict = {}                       # tx_id -> [notes]
+
+    # -- transaction notes (CordaRPCOps.addVaultTransactionNote) ------------
+    def add_transaction_note(self, tx_id, note: str) -> None:
+        with self._lock:
+            self._tx_notes.setdefault(tx_id, []).append(note)
+
+    def get_transaction_notes(self, tx_id) -> list[str]:
+        with self._lock:
+            return list(self._tx_notes.get(tx_id, ()))
 
     # -- relevance ----------------------------------------------------------
     def _is_relevant(self, state) -> bool:

@@ -83,14 +83,43 @@ class Explorer:
 
     def watch(self, interval_s: float = 2.0, iterations: int | None = None
               ) -> None:
-        """Live dashboard: clear + re-render on a cadence (the GUI's feed
-        subscription becomes polling over the identical RPC surface)."""
+        """Live dashboard driven by PUSHED feed observations (the GUI's
+        observable subscriptions, RPCClientProxyHandler demux): subscribe to
+        the vault / transaction / state-machine / network-map feeds and
+        re-render when an update arrives. ``interval_s`` only caps the idle
+        redraw cadence; falls back to interval polling against an ops object
+        without streaming feeds."""
+        import threading
+        wake = threading.Event()
+        feeds = []
+        for feed_op in ("vault_feed", "verified_transactions_feed",
+                        "state_machines_feed", "network_map_feed"):
+            try:
+                feed = getattr(self.ops, feed_op)()
+            except Exception:
+                continue
+            if hasattr(feed, "subscribe"):
+                feed.subscribe(lambda _update: wake.set())
+                feeds.append(feed)
         n = 0
-        while iterations is None or n < iterations:
-            print("\x1b[2J\x1b[H" + self.render(), flush=True)
-            n += 1
-            if iterations is None or n < iterations:
-                time.sleep(interval_s)
+        try:
+            while iterations is None or n < iterations:
+                print("\x1b[2J\x1b[H" + self.render(), flush=True)
+                n += 1
+                if iterations is None or n < iterations:
+                    if feeds:
+                        wake.wait(timeout=interval_s)
+                        wake.clear()
+                    else:
+                        time.sleep(interval_s)
+        finally:
+            for feed in feeds:
+                close = getattr(feed, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
 
 
 def main(argv=None) -> int:
