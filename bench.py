@@ -64,10 +64,13 @@ def host_baseline_rate(items) -> float:
 
 
 def device_rate(items) -> float:
+    import functools
     kitems = [(pub, msg, r, s) for _, pub, msg, r, s in items]
-    *args, pre = wc_ops.prepare_batch_hybrid(kitems)
+    *args, pre = wc_ops.prepare_batch_hybrid_wide(
+        kitems, wc_ops.HYBRID_G_WINDOW)
     assert pre.all()
-    fn = wc_ops._verify_kernel_hybrid
+    fn = functools.partial(wc_ops._verify_kernel_hybrid_wide,
+                           g_w=wc_ops.HYBRID_G_WINDOW)
     ok = np.asarray(fn(*args))  # compile + warm
     assert bool(ok.all()), "benchmark signatures must all verify"
     t0 = time.perf_counter()

@@ -385,43 +385,6 @@ def prepare_batch_glv(items):
 # Hybrid GLV path (secp256k1): constant-table G legs + selected Q legs
 # ---------------------------------------------------------------------------
 
-_G_TABLES: dict[str, tuple] = {}
-
-GLV_WINDOWS = (GLV_BITS + 1) // 2   # 65 two-bit windows, MSB-first
-
-
-def _g_window_table(curve: WeierstrassCurve):
-    """(64, NLIMB)-per-coordinate constant projective table indexed by
-    ``wa + 4·wb + 16·sa + 32·sb``: entry = wa·(sa ? -G : G) + wb·(sb ?
-    -phi(G) : phi(G)) for 2-bit window digits wa, wb ∈ [0, 4). Identity
-    rows are (0 : 1 : 0). G and phi(G) are curve constants, so the whole
-    table is baked into the kernel; per-item rows come from one gather."""
-    if curve.name in _G_TABLES:
-        return _G_TABLES[curve.name]
-    p = curve.p
-    phi_g = (SECP256K1_BETA * curve.g[0] % p, curve.g[1])
-    xs, ys, zs = [], [], []
-    for idx in range(64):
-        wa, wb = idx & 3, (idx >> 2) & 3
-        sa, sb = (idx >> 4) & 1, (idx >> 5) & 1
-        pt = None
-        ga = (curve.g[0], (p - curve.g[1]) % p) if sa else curve.g
-        gb = (phi_g[0], (p - phi_g[1]) % p) if sb else phi_g
-        for _ in range(wa):
-            pt = ga if pt is None else curve.add(pt, ga)
-        for _ in range(wb):
-            pt = gb if pt is None else curve.add(pt, gb)
-        xs.append(0 if pt is None else pt[0])
-        ys.append(1 if pt is None else pt[1])
-        zs.append(0 if pt is None else 1)
-    # Cache NUMPY constants: the first call may happen inside a jit trace, and
-    # caching trace-created jnp arrays would leak tracers into later traces
-    # (callers jnp.asarray per trace — a free constant).
-    tab = tuple(F.to_limbs(v) for v in (xs, ys, zs))
-    _G_TABLES[curve.name] = tab
-    return tab
-
-
 def _q_window_table(Qc, Qd, curve: WeierstrassCurve):
     """16-entry per-item table T[i + 4j] = [i]Qc + [j]Qd (i, j ∈ [0,4)):
     2 doublings + 12 complete adds, one-time per batch."""
@@ -439,70 +402,149 @@ def _q_window_table(Qc, Qd, curve: WeierstrassCurve):
     return T
 
 
-def hybrid_ladder(g_idx, q_bits, Qc, Qd, curve: WeierstrassCurve):
-    """[|a|](±G) + [|b|](±phi G) + [c]Qc + [d]Qd over GLV_WINDOWS 2-bit
-    windows: per window, 2 doublings + ONE constant-table G add (64-entry
-    gather) + ONE Q add (16-entry per-item select tree) — 40 schoolbook
-    products per 2 scalar bits versus 64 for the 1-bit ladder this replaced
-    (measured faster despite the deeper select tree; the per-item Q window
-    table costs 2 dbl + 12 adds one-time).
+#: Default constant-G window width for the hybrid kernel. Measured on v5e
+#: at batch 32k: w=2 36.1k, w=4 41.5k, w=6 44.9k verifies/s (the G table is
+#: a free kernel constant — 2^14 entries at w=6 — so widening trades only
+#: table size for fewer G adds). w=8 would need a 2^18-entry (~100MB) table.
+HYBRID_G_WINDOW = 6
 
-    ``g_idx``: (W, B) int32 into the 64-entry G window table.
-    ``q_bits``: (W, B, 4) window digit bit-planes (wc&1, wc>>1, wd&1, wd>>1).
+_G_TABLES_WIDE: dict[tuple, tuple] = {}
+
+
+def _g_window_table_wide(curve: WeierstrassCurve, w: int):
+    """(2^(2w+2), NLIMB)-per-coordinate constant projective table indexed by
+    ``wa + 2^w·wb + 2^(2w)·sa + 2^(2w+1)·sb``: entry = wa·(sa ? -G : G) +
+    wb·(sb ? -phi(G) : phi(G)) for w-bit digits wa, wb ∈ [0, 2^w).
+    Identity rows are (0 : 1 : 0). Pure curve constants → baked into the
+    kernel; widening w trades (free) table size for FEWER G adds in the
+    ladder: one G add per w bits instead of per 2."""
+    key = (curve.name, w)
+    if key in _G_TABLES_WIDE:
+        return _G_TABLES_WIDE[key]
+    p, g = curve.p, curve.g
+    phi = (SECP256K1_BETA * g[0] % p, g[1])
+    span = 1 << w
+
+    def multiples(base):
+        out = [None] * span          # None = identity
+        acc = None
+        for i in range(1, span):
+            acc = base if acc is None else curve.add(acc, base)
+            out[i] = acc
+        return out
+    g_mult = multiples(g)
+    phi_mult = multiples(phi)
+
+    def neg(pt):
+        return None if pt is None else (pt[0], (p - pt[1]) % p)
+
+    xs, ys, zs = [], [], []
+    for sb in (False, True):
+        for sa in (False, True):
+            for wb in range(span):
+                for wa in range(span):
+                    a_pt = neg(g_mult[wa]) if sa else g_mult[wa]
+                    b_pt = neg(phi_mult[wb]) if sb else phi_mult[wb]
+                    if a_pt is None and b_pt is None:
+                        pt, is_id = (0, 1), True
+                    elif a_pt is None:
+                        pt, is_id = b_pt, False
+                    elif b_pt is None:
+                        pt, is_id = a_pt, False
+                    else:
+                        pt, is_id = curve.add(a_pt, b_pt), False
+                        if pt is None:       # wa·(±G) = -(wb·(±phi G))
+                            pt, is_id = (0, 1), True
+                    xs.append(pt[0])
+                    ys.append(pt[1])
+                    zs.append(0 if is_id else 1)
+    tab = tuple(F.to_limbs(v) for v in (xs, ys, zs))
+    _G_TABLES_WIDE[key] = tab
+    return tab
+
+
+def hybrid_ladder_wide(g_idx, q_bits, Qc, Qd, curve: WeierstrassCurve,
+                       g_w: int):
+    """The hybrid ladder with a WIDER constant-G window: per outer step,
+    ``g_w`` bits are consumed — g_w doublings, g_w/2 Q adds (2-bit per-item
+    windows, unchanged), and ONE G add from the 2^(2·g_w+2)-entry constant
+    table. Fewer G adds per bit is free compute: the table is a kernel
+    constant, only the ladder shrinks.
+
+    ``g_idx``: (W_g, B) table indices; ``q_bits``: (W_g, g_w//2, B, 4).
     """
     batch_shape = Qc[0].shape[:-1]
     Pid = identity(batch_shape)
     table = _q_window_table(Qc, Qd, curve)
-    gtab = tuple(jnp.asarray(t) for t in _g_window_table(curve))
+    gtab = tuple(jnp.asarray(t) for t in _g_window_table_wide(curve, g_w))
 
-    def step(acc, ins):
-        gi, qb = ins
-        acc = dbl(dbl(acc, curve), curve)
-        g_addend = tuple(t[gi] for t in gtab)
-        acc = add(acc, g_addend, curve)
+    def q_addend(qb):
         level = table
         for j in range(4):                # fold by index bit j (LSB first)
             b = qb[..., j].astype(jnp.bool_)
             level = [tuple(F.select(b, hi_c, lo_c)
                            for lo_c, hi_c in zip(lo, hi))
                      for lo, hi in zip(level[0::2], level[1::2])]
-        return add(acc, level[0], curve), None
+        return level[0]
 
-    acc, _ = jax.lax.scan(step, Pid, (g_idx, q_bits), unroll=2)
+    def step(acc, ins):
+        gi, qb = ins                      # qb: (g_w//2, B, 4)
+        for t in range(g_w // 2):
+            acc = dbl(dbl(acc, curve), curve)
+            acc = add(acc, q_addend(qb[t]), curve)
+        return add(acc, tuple(t[gi] for t in gtab), curve), None
+
+    # unroll=2 measured SLOWER here (43.6k vs 44.9k/s on v5e): the wide
+    # step body is already 6 dbl + 4 adds — unrolling doubles an already
+    # register-heavy body for nothing
+    acc, _ = jax.lax.scan(step, Pid, (g_idx, q_bits))
     return acc
 
 
-def verify_core_hybrid(g_idx, q_bits, Qc, Qd, r_cands):
-    # upcast the compact wire dtypes (u8 indices/bits, u16 limbs) on device
+def verify_core_hybrid_wide(g_idx, q_bits, Qc, Qd, r_cands, g_w: int):
     g_idx = jnp.asarray(g_idx, jnp.int32)
     q_bits = jnp.asarray(q_bits, jnp.uint64)
     Qc = tuple(jnp.asarray(c, jnp.uint64) for c in Qc)
     Qd = tuple(jnp.asarray(c, jnp.uint64) for c in Qd)
     r_cands = jnp.asarray(r_cands, jnp.uint64)
     curve = CURVES["secp256k1"]
-    X, Y, Z = hybrid_ladder(g_idx, q_bits, Qc, Qd, curve)
+    X, Y, Z = hybrid_ladder_wide(g_idx, q_bits, Qc, Qd, curve, g_w)
     return _accept(X, Z, r_cands, curve.p)
 
 
-_verify_kernel_hybrid = jax.jit(verify_core_hybrid)
+_verify_kernel_hybrid_wide = jax.jit(verify_core_hybrid_wide,
+                                     static_argnames=("g_w",))
 
 
 def _bits_to_windows(bits: np.ndarray) -> np.ndarray:
-    """(GLV_BITS, B) MSB-first bit array → (GLV_WINDOWS, B) 2-bit digits,
-    MSB-first (a leading zero bit is prepended when GLV_BITS is odd)."""
+    """(nbits, B) MSB-first bit array → (nbits/2, B) 2-bit digits, MSB-first
+    (a leading zero bit is prepended when nbits is odd) — the Q legs'
+    per-item window digits."""
     if bits.shape[0] % 2:
         bits = np.concatenate(
             [np.zeros((1,) + bits.shape[1:], bits.dtype), bits])
     return bits[0::2] * 2 + bits[1::2]
 
 
-def prepare_batch_hybrid(items):
-    """Host prep for the hybrid kernel: GLV-decompose u1 (G legs: signs into
-    the gather index) and u2 (Q legs: signs folded into the points), then
-    split each scalar into 2-bit windows MSB-first."""
+def _bits_to_w_windows(bits: np.ndarray, w: int) -> np.ndarray:
+    """(nbits, B) MSB-first bits → (nbits//w, B) w-bit digits, MSB-first."""
+    n_w = bits.shape[0] // w
+    grouped = bits[: n_w * w].reshape(n_w, w, *bits.shape[1:])
+    weights = (1 << np.arange(w - 1, -1, -1, dtype=np.uint32))
+    return np.tensordot(weights, grouped.astype(np.uint32), axes=([0], [1]))
+
+
+def prepare_batch_hybrid_wide(items, g_w: int):
+    """Host prep for the wide-G hybrid kernel: GLV-decompose u1 (G legs:
+    g_w-bit digits + signs into the gather index — one gather per g_w bits)
+    and u2 (Q legs: 2-bit per-item windows, signs folded into the points),
+    with the Q window planes grouped per outer step."""
+    if g_w % 2 or g_w < 2:
+        raise ValueError(f"g_w must be even and >= 2, got {g_w}")
     curve = CURVES["secp256k1"]
     p = curve.p
     precheck, pubs, u1s, u2s, r0, r1 = _precheck_and_scalars(curve, items)
+    nbits = -(-GLV_BITS // g_w) * g_w          # pad to a g_w multiple
     sa, sb, abs_a, abs_b = [], [], [], []
     cs, ds, qc_pts, qd_pts = [], [], [], []
     for pub, u1, u2 in zip(pubs, u1s, u2s):
@@ -518,18 +560,18 @@ def prepare_batch_hybrid(items):
                 k, pt = -k, (pt[0], (p - pt[1]) % p)
             ks.append(k)
             kpts.append(pt)
-    wa = _bits_to_windows(F.scalars_to_bits(abs_a, GLV_BITS))
-    wb = _bits_to_windows(F.scalars_to_bits(abs_b, GLV_BITS))
-    # compact wire dtypes: table indices fit u8, window bits are 0/1, limbs
-    # are canonical 16-bit — the kernel upcasts on device (transfer-bound
-    # otherwise: a 32k batch shipped ~110MB as u64, ~14MB compact)
-    g_idx = (wa + 4 * wb
-             + 16 * np.asarray(sa, dtype=np.uint32)[None, :]
-             + 32 * np.asarray(sb, dtype=np.uint32)[None, :]).astype(np.uint8)
-    wc = _bits_to_windows(F.scalars_to_bits(cs, GLV_BITS))
-    wd = _bits_to_windows(F.scalars_to_bits(ds, GLV_BITS))
-    q_bits = np.stack([wc & 1, wc >> 1, wd & 1, wd >> 1],
-                      axis=-1).astype(np.uint8)
+    wa = _bits_to_w_windows(F.scalars_to_bits(abs_a, nbits), g_w)
+    wb = _bits_to_w_windows(F.scalars_to_bits(abs_b, nbits), g_w)
+    g_idx = (wa + (wb << g_w)
+             + (np.asarray(sa, dtype=np.uint32)[None, :] << (2 * g_w))
+             + (np.asarray(sb, dtype=np.uint32)[None, :] << (2 * g_w + 1))
+             ).astype(np.int32 if g_w > 6 else np.uint16)
+    wc = _bits_to_windows(F.scalars_to_bits(cs, nbits))
+    wd = _bits_to_windows(F.scalars_to_bits(ds, nbits))
+    q_planes = np.stack([wc & 1, wc >> 1, wd & 1, wd >> 1],
+                        axis=-1).astype(np.uint8)          # (nbits/2, B, 4)
+    n_g = nbits // g_w
+    q_bits = q_planes.reshape(n_g, g_w // 2, *q_planes.shape[1:])
     r_cands = jnp.asarray(np.stack(
         [F.to_limbs(r0), F.to_limbs(r1)]).astype(np.uint16))
     return (jnp.asarray(g_idx), jnp.asarray(q_bits),
@@ -601,8 +643,9 @@ def verify_batch(curve: WeierstrassCurve,
     if mode != "plain" and curve.name != "secp256k1":
         raise ValueError(f"mode {mode!r} requires secp256k1")
     if mode == "hybrid":
-        *args, precheck = prepare_batch_hybrid(padded)
-        ok = np.asarray(_verify_kernel_hybrid(*args))
+        *args, precheck = prepare_batch_hybrid_wide(padded, HYBRID_G_WINDOW)
+        ok = np.asarray(_verify_kernel_hybrid_wide(*args,
+                                                   g_w=HYBRID_G_WINDOW))
     elif mode == "glv":
         bits4, pts4, r_cands, precheck = prepare_batch_glv(padded)
         ok = np.asarray(_verify_kernel_glv(bits4, pts4, r_cands))
@@ -624,8 +667,9 @@ def verify_batch_async(curve: WeierstrassCurve,
         return (None, np.zeros(0, dtype=bool), 0)
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
     if curve.name == "secp256k1":
-        *args, precheck = prepare_batch_hybrid(padded)
-        return (_verify_kernel_hybrid(*args), precheck, n)
+        *args, precheck = prepare_batch_hybrid_wide(padded, HYBRID_G_WINDOW)
+        return (_verify_kernel_hybrid_wide(*args, g_w=HYBRID_G_WINDOW),
+                precheck, n)
     u1_bits, u2_bits, q_pts, r_cands, precheck = prepare_batch(curve, padded)
     return (_verify_kernel(u1_bits, u2_bits, q_pts, r_cands, curve.name),
             precheck, n)

@@ -82,17 +82,21 @@ def sharded_ecdsa_verify(mesh: Mesh, curve_name: str):
 
 
 def sharded_ecdsa_verify_hybrid(mesh: Mesh):
-    """Batch-sharded secp256k1 verify over the HYBRID GLV kernel — the
-    fastest single-chip path (ops.weierstrass.verify_core_hybrid), scaled
-    the same dp way.
+    """Batch-sharded secp256k1 verify over the HYBRID GLV kernel at the
+    default wide-G window — the fastest single-chip path
+    (ops.weierstrass.verify_core_hybrid_wide), scaled the same dp way.
 
-    Input layout (from ops.weierstrass.prepare_batch_hybrid): g_idx
-    (W, B) int32; q_bits (W, B, 4); Qc/Qd 3×(B, 16); r_cands (2, B, 16).
+    Input layout (from ops.weierstrass.prepare_batch_hybrid_wide): g_idx
+    (W_g, B); q_bits (W_g, g_w/2, B, 4); Qc/Qd 3×(B, 16); r_cands
+    (2, B, 16).
     """
+    core = functools.partial(wc_ops.verify_core_hybrid_wide,
+                             g_w=wc_ops.HYBRID_G_WINDOW)
     shmapped = jax.shard_map(
-        wc_ops.verify_core_hybrid, mesh=mesh,
-        in_specs=(P(None, AXIS), P(None, AXIS, None), (P(AXIS, None),) * 3,
-                  (P(AXIS, None),) * 3, P(None, AXIS, None)),
+        core, mesh=mesh,
+        in_specs=(P(None, AXIS), P(None, None, AXIS, None),
+                  (P(AXIS, None),) * 3, (P(AXIS, None),) * 3,
+                  P(None, AXIS, None)),
         out_specs=P(AXIS),
         check_vma=False)  # see sharded_ed25519_verify
     return jax.jit(shmapped)
@@ -157,7 +161,7 @@ def sharded_verify_batch_secp256k1(mesh: Mesh, items, _cache={}):
         return np.zeros(0, dtype=bool)
     padded = items + [items[-1]] * (_pad_to_mesh_bucket(n, mesh) - n)
     g_idx, q_bits, Qc, Qd, r_cands, precheck = \
-        wc_ops.prepare_batch_hybrid(padded)
+        wc_ops.prepare_batch_hybrid_wide(padded, wc_ops.HYBRID_G_WINDOW)
     key = ("secp256k1", id(mesh))
     if key not in _cache:
         _cache[key] = sharded_ecdsa_verify_hybrid(mesh)

@@ -62,7 +62,8 @@ def test_sharded_hybrid_ecdsa_matches_host(mesh):
             msg = msg + b"x"
         items.append((pub, msg, r, s))
         want.append(ecmath.ecdsa_verify(curve, pub, msg, r, s))
-    *args, precheck = wc_ops.prepare_batch_hybrid(items)
+    *args, precheck = wc_ops.prepare_batch_hybrid_wide(
+        items, wc_ops.HYBRID_G_WINDOW)
     fn = sharded_ecdsa_verify_hybrid(mesh)
     ok = np.asarray(fn(*args)) & precheck
     assert list(ok) == want
