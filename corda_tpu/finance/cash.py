@@ -110,10 +110,17 @@ def _group_token(states):
 
 
 class IssueClause(Clause):
+    """Issuance rules, parametric over the asset's command types so other
+    fungible assets (finance.commodity) reuse the clause WITHOUT sharing
+    command classes — shared classes would let one contract's isinstance
+    filter capture the other's commands in a mixed transaction."""
+
+    issue_command = Issue
     required_commands = (Issue,)
 
     def verify(self, tx, inputs, outputs, commands, token) -> set:
-        issue_cmds = [c for c in commands if isinstance(c.value, Issue)]
+        issue_cmds = [c for c in commands
+                      if isinstance(c.value, self.issue_command)]
         if not issue_cmds:
             return set()
         out_sum = sum_or_zero((s.amount for s in outputs), token)
@@ -135,16 +142,19 @@ class IssueClause(Clause):
 
 
 class MoveClause(Clause):
+    move_command = Move
+    exit_command = Exit
     required_commands = (Move,)
 
     def verify(self, tx, inputs, outputs, commands, token) -> set:
-        move_cmds = [c for c in commands if isinstance(c.value, Move)]
+        move_cmds = [c for c in commands
+                     if isinstance(c.value, self.move_command)]
         if not move_cmds:
             return set()
         in_sum = sum_or_zero((s.amount for s in inputs), token)
         out_sum = sum_or_zero((s.amount for s in outputs), token)
         exit_amount = sum((c.value.amount.quantity for c in commands
-                           if isinstance(c.value, Exit)
+                           if isinstance(c.value, self.exit_command)
                            and c.value.amount.token == token), 0)
         if in_sum.quantity != out_sum.quantity + exit_amount:
             raise TransactionVerificationException(
@@ -160,10 +170,12 @@ class MoveClause(Clause):
 
 
 class ExitClause(Clause):
+    exit_command = Exit
     required_commands = (Exit,)
 
     def verify(self, tx, inputs, outputs, commands, token) -> set:
-        exit_cmds = [c for c in commands if isinstance(c.value, Exit)
+        exit_cmds = [c for c in commands
+                     if isinstance(c.value, self.exit_command)
                      and c.value.amount.token == token]
         if not exit_cmds:
             return set()

@@ -324,13 +324,21 @@ class BroadcastTransactionFlow(FlowLogic):
             if str(party.name) in sent:
                 continue
             sent.add(str(party.name))
-            yield Send(party, NotifyTxRequest(self.stx))
+            # ACKNOWLEDGED delivery: the reference rides durable broker
+            # queues, so a recipient that is down still gets the broadcast
+            # on recovery; the TCP plane has no such durability, so the
+            # sender waits until the recipient has RECORDED the transaction
+            # — a finalised payment can no longer vanish with a crashed
+            # recipient's in-flight frame
+            resp = yield SendAndReceive(party, NotifyTxRequest(self.stx),
+                                        bytes)
+            resp.unwrap(lambda ack: ack)
         return None
 
 
 class NotifyTransactionHandler(FlowLogic):
     """Receives a broadcast transaction: resolve deps from the sender, verify,
-    record (CoreFlowHandlers.kt NotifyTransactionHandler)."""
+    record, acknowledge (CoreFlowHandlers.kt NotifyTransactionHandler)."""
 
     def __init__(self, peer):
         self.peer = peer
@@ -341,6 +349,7 @@ class NotifyTransactionHandler(FlowLogic):
         yield from self.sub_flow(ResolveTransactionsFlow(self.peer, stx=stx))
         stx.verify(self.service_hub, check_sufficient_signatures=False)
         self.service_hub.record_transactions(stx)
+        yield Send(self.peer, b"ack")
         return None
 
 
@@ -382,6 +391,20 @@ class FinalityFlow(FlowLogic):
                     seen.add(party.owning_key)
                     parties.append(party)
         return parties
+
+
+@initiating_flow
+class ManualFinalityFlow(FinalityFlow):
+    """FinalityFlow that broadcasts ONLY to the explicitly named recipients —
+    no participant derivation (core ManualFinalityFlow: used when states'
+    participants cannot be resolved to well-known parties, e.g. anonymous
+    or externally-held keys)."""
+
+    def __init__(self, stx: SignedTransaction, recipients):
+        super().__init__(stx, extra_recipients=recipients)
+
+    def _participant_parties(self, stx):
+        return []
 
 
 def _party_by_key(hub, key):

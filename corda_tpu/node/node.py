@@ -111,6 +111,12 @@ register_type("rpc.Observation", Observation)
 class Node:
     def __init__(self, config: NodeConfiguration):
         self.config = config
+        # cordapps must load BEFORE the durable stores open: a restarted
+        # node deserializes its recorded transactions at construction, and
+        # their state/command types live in the cordapp modules
+        import importlib
+        for module in config.cordapps:
+            importlib.import_module(module)
         os.makedirs(config.base_directory, exist_ok=True)
         self.key_pair = self._load_or_create_identity()
         self.party = Party(config.my_legal_name, self.key_pair.public)
@@ -144,6 +150,13 @@ class Node:
         from .services import DurableTransactionStorage
         self.services.storage = DurableTransactionStorage(
             os.path.join(config.base_directory, "transactions.kv"))
+        # RESTART path: the vault (and its observers — schema tables,
+        # scheduler) is an in-memory index over the durable store; replay
+        # the recorded transactions in order so a restarted node still
+        # holds its pre-crash states (spends re-consume as they replay)
+        stored = self.services.storage.transactions
+        if stored:
+            self.services.vault.notify_all(stored)
         checkpoint_storage = KvCheckpointStorage(
             os.path.join(config.base_directory, "checkpoints.kv"))
         self.services.verifier_service = self._make_verifier()
@@ -215,9 +228,6 @@ class Node:
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Node":
-        import importlib
-        for module in self.config.cordapps:
-            importlib.import_module(module)
         self.messaging.add_message_handler(TopicSession(TOPIC_RPC),
                                            self._on_rpc)
         if self.config.network_map_name is None:

@@ -27,6 +27,9 @@ class NodeHandle:
     port: int
     process: subprocess.Popen
     rpc: CordaRPCClient
+    # spawn configuration, so restart_node restores the SAME role
+    notary: str | None = None
+    verifier_type: str = "InMemory"
 
     def stop(self) -> None:
         if self.rpc is not None:
@@ -105,6 +108,22 @@ class DriverDSL:
                         f"{handle.name} sees fewer than {min_nodes} nodes")
                 time.sleep(0.3)
 
+    def restart_node(self, handle: NodeHandle) -> NodeHandle:
+        """Restart a (possibly killed) node subprocess on the SAME base
+        directory and with the SAME role (notary/verifier config recorded
+        at spawn): identity key, durable transaction store and checkpoints
+        are reloaded from disk; the node re-registers its new address with
+        the network map (the loadtest kill/restart disruption,
+        Disruption.kt:17-105)."""
+        if handle.process.poll() is None:
+            handle.stop()
+        elif handle.rpc is not None:
+            handle.rpc.close()
+        if handle in self.nodes:
+            self.nodes.remove(handle)
+        return self._spawn(handle.name, notary=handle.notary,
+                           verifier_type=handle.verifier_type)
+
     def start_verifier(self, queue_address: str, use_device: bool = True,
                        host_crossover: int | None = None,
                        stats_file: str | None = None,
@@ -165,7 +184,8 @@ class DriverDSL:
         # process lifetime, so the node never blocks on a full pipe
         host, port = await_node_ready(proc, name, self.startup_timeout_s)
         rpc = CordaRPCClient(host, port)
-        handle = NodeHandle(name, host, port, proc, rpc)
+        handle = NodeHandle(name, host, port, proc, rpc,
+                            notary=notary, verifier_type=verifier_type)
         self.nodes.append(handle)
         return handle
 

@@ -112,6 +112,71 @@ def run_load_test(test: LoadTest, ctx, iterations: int, seed: int = 0,
 # The standard scenarios (SelfIssueTest / CrossCashTest analogs)
 # ---------------------------------------------------------------------------
 
+def run_driver_cluster_load(dsl, parties, notary_party, iterations: int = 12,
+                            seed: int = 0, kill_restart_at: int | None = None,
+                            report_path: str | None = None) -> dict:
+    """Drive a REAL subprocess cluster (testing.driver DriverDSL) with the
+    self-issue/cross-cash mix over RPC, optionally hard-killing and
+    restarting one node mid-load (LoadTest.kt executed against Driver-
+    started processes + Disruption.kt's kill/restart, the real-cluster
+    edition the reference runs over SSH).
+
+    ``parties``: mutable list of NodeHandle; index 1 is the kill victim.
+    Returns (and optionally writes) a BENCH-style JSON report with the
+    measured flows/s and the conservation check result.
+    """
+    import json
+    import time
+
+    rng = random.Random(seed)
+    issued_total = 0
+    flows_done = 0
+    t0 = time.monotonic()
+    for it in range(iterations):
+        if kill_restart_at is not None and it == kill_restart_at:
+            victim = parties[1]
+            victim.process.kill()            # no goodbye, no flush
+            victim.process.wait(timeout=15)
+            parties[1] = dsl.restart_node(victim)
+        issuer = parties[rng.randrange(len(parties))]
+        quantity = rng.randint(1, 500) * 100
+        issuer.rpc.start_flow_and_wait(
+            "CashIssueFlow", Amount(quantity, USD), b"\x01",
+            issuer.rpc.node_identity().legal_identity, notary_party,
+            timeout_s=120)
+        issued_total += quantity
+        flows_done += 1
+        if len(parties) > 1 and rng.random() < 0.5:
+            a, b = rng.sample(range(len(parties)), 2)
+            balances = parties[a].rpc.get_cash_balances()
+            spendable = balances.get("USD", 0)
+            if spendable >= 100:
+                pay = min(spendable, rng.randint(1, 50) * 100)
+                parties[a].rpc.start_flow_and_wait(
+                    "CashPaymentFlow", Amount(pay, USD),
+                    parties[b].rpc.node_identity().legal_identity,
+                    timeout_s=120)
+                flows_done += 1
+    elapsed = time.monotonic() - t0
+    held_total = sum(h.rpc.get_cash_balances().get("USD", 0)
+                     for h in parties)
+    report = {
+        "metric": "driver_cluster_flows_per_sec",
+        "value": round(flows_done / elapsed, 3),
+        "unit": "flows/s",
+        "flows": flows_done,
+        "elapsed_s": round(elapsed, 1),
+        "issued_total": issued_total,
+        "held_total": held_total,
+        "conserved": held_total == issued_total,
+        "kill_restart_at": kill_restart_at,
+    }
+    if report_path is not None:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
 def self_issue_test() -> LoadTest:
     """Nodes repeatedly self-issue cash; the invariant is that every node's
     vault total equals the model's issued total (SelfIssueTest.kt)."""

@@ -1,0 +1,60 @@
+"""Smoke-test tier: drive the INSTALLED node artifact as a black box.
+
+Reference analog: smoke-test-utils NodeProcess.kt:68-147 — boot the
+packaged corda.jar from outside, connect a standalone RPC client, do real
+work, shut down cleanly. Here the artifact is the console entry point
+(`corda-tpu-node`, pyproject [project.scripts]) when installed, falling
+back to the equivalent `python -m corda_tpu.node` module form; the test
+uses ONLY the public CLI + RPC client, no test fixtures.
+"""
+import shutil
+import signal
+import subprocess
+import sys
+
+import pytest
+
+import corda_tpu.finance  # noqa: F401 — client-side wire types
+from corda_tpu.core.contracts.amount import Amount, USD
+from corda_tpu.client.rpc import CordaRPCClient
+from corda_tpu.testing.driver import await_node_ready
+
+
+def _node_command() -> list[str]:
+    exe = shutil.which("corda-tpu-node")
+    if exe is not None:
+        return [exe]
+    return [sys.executable, "-m", "corda_tpu.node"]
+
+
+@pytest.mark.slow
+def test_black_box_node_smoke(tmp_path):
+    proc = subprocess.Popen(
+        _node_command() + ["--name", "O=Smoke, L=London, C=GB",
+                           "--port", "0", "--base-dir", str(tmp_path),
+                           "--notary", "simple", "--quiet"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        host, port = await_node_ready(proc, "smoke", timeout_s=120.0)
+        client = CordaRPCClient(host, port)
+        try:
+            info = client.node_identity()
+            assert str(info.legal_identity.name) == "O=Smoke, L=London, C=GB"
+            me = info.legal_identity
+            notary = client.notary_identities()[0]
+            result = client.start_flow_and_wait(
+                "CashIssueFlow", Amount(1234, USD), b"\x01", me, notary,
+                timeout_s=120)
+            assert result is not None
+            assert client.get_cash_balances() == {"USD": 1234}
+            assert "CashPaymentFlow" in str(client.registered_flows())
+        finally:
+            client.close()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            rc = proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            pytest.fail("node did not shut down on SIGTERM")
+        assert rc == 0, f"node exited with {rc}"
