@@ -112,6 +112,18 @@ def render_yaml(value, indent: int = 0) -> str:
 _AMOUNT_RE = re.compile(r"^(\d+)(?:\.(\d{1,2}))?\s+([A-Z]{3})$")
 
 
+def _parse_amount(text: str) -> Amount | None:
+    """"100.50 USD" → Amount(10050, USD); None when the shape doesn't match
+    (single source of truth for both the annotated and shape-inferred
+    conversion paths)."""
+    m = _AMOUNT_RE.match(text)
+    if not m:
+        return None
+    whole, cents, code = m.groups()
+    quantity = int(whole) * 100 + int((cents or "0").ljust(2, "0"))
+    return Amount(quantity, currency(code))
+
+
 class StringToMethodCallParser:
     """Bind ``name: value`` text to a callable's parameters
     (StringToMethodCallParser.kt:1-225). Values convert by the parameter's
@@ -133,11 +145,9 @@ class StringToMethodCallParser:
             return int(text)
         if text.startswith("0x"):
             return bytes.fromhex(text[2:])
-        m = _AMOUNT_RE.match(text)
-        if m:
-            whole, cents, code = m.groups()
-            quantity = int(whole) * 100 + int((cents or "0").ljust(2, "0"))
-            return Amount(quantity, currency(code))
+        amount = _parse_amount(text)
+        if amount is not None:
+            return amount
         if "=" in text and self.party_resolver is not None:
             party = self.party_resolver(text)
             if party is not None:
@@ -161,13 +171,11 @@ class StringToMethodCallParser:
         if ann is str:
             return text.strip('"')
         if ann is Amount:
-            m = _AMOUNT_RE.match(text)
-            if not m:
+            amount = _parse_amount(text)
+            if amount is None:
                 raise UnparseableCallException(
                     f"{text!r} is not an amount (want e.g. '100.00 USD')")
-            whole, cents, code = m.groups()
-            return Amount(int(whole) * 100 + int((cents or "0").ljust(2, "0")),
-                          currency(code))
+            return amount
         if ann is Party:
             party = (self.party_resolver(text)
                      if self.party_resolver is not None else None)

@@ -320,6 +320,7 @@ class BroadcastTransactionFlow(FlowLogic):
     def call(self):
         me = str(self.service_hub.my_info.legal_identity.name)
         sent = {me}
+        undelivered = []
         for party in self.participants:
             if str(party.name) in sent:
                 continue
@@ -329,10 +330,21 @@ class BroadcastTransactionFlow(FlowLogic):
             # on recovery; the TCP plane has no such durability, so the
             # sender waits until the recipient has RECORDED the transaction
             # — a finalised payment can no longer vanish with a crashed
-            # recipient's in-flight frame
-            resp = yield SendAndReceive(party, NotifyTxRequest(self.stx),
-                                        bytes)
-            resp.unwrap(lambda ack: ack)
+            # recipient's in-flight frame. A failed recipient must not
+            # starve the REMAINING recipients (the transaction is already
+            # final): every delivery is attempted, then the undelivered
+            # set surfaces as one error.
+            try:
+                resp = yield SendAndReceive(party, NotifyTxRequest(self.stx),
+                                            bytes)
+                resp.unwrap(lambda ack: ack)
+            except FlowException as e:
+                undelivered.append((party, str(e)))
+        if undelivered:
+            names = ", ".join(str(p.name) for p, _ in undelivered)
+            raise FlowException(
+                f"transaction {self.stx.id.prefix_chars()} is FINAL but "
+                f"could not be delivered to: {names}")
         return None
 
 

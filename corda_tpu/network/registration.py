@@ -72,9 +72,11 @@ class DoormanService:
         if len(cns) != 1 or not cns[0].value.strip():
             raise RegistrationError("CSR must carry exactly one common name")
         common_name = cns[0].value
-        if common_name in self._issued_names:
+        pending_names = {cn for cn, _ in self._pending.values()}
+        if common_name in self._issued_names or common_name in pending_names:
             raise RegistrationError(
-                f"a certificate for {common_name!r} was already issued")
+                f"a certificate for {common_name!r} was already "
+                f"issued or requested")
         request_id = uuid.uuid4().hex
         self._pending[request_id] = (common_name, csr_pem)
         if self.auto_approve:
@@ -143,16 +145,35 @@ class NetworkRegistrationHelper:
     def register(self) -> tuple[str, str]:
         """Run the enrolment; returns (cert_path, key_path). Idempotent:
         an already-installed certificate short-circuits (the reference
-        helper's keystore check)."""
+        helper's keystore check), and an in-flight request — key + request
+        id persisted BEFORE polling — is RESUMED by a later register()
+        instead of re-submitted, so a poll timeout followed by late
+        operator approval still enrols (NetworkRegistrationHelper's
+        requestIdStore)."""
+        import json
         _, _, serialization, ec = _modules()
         os.makedirs(self.node_directory, exist_ok=True)
         cert_path = os.path.join(self.node_directory, "tls-node.crt")
         key_path = os.path.join(self.node_directory, "tls-node.key")
+        pending_path = os.path.join(self.node_directory,
+                                    "enrolment-request.json")
         if os.path.exists(cert_path):
             return cert_path, key_path
-        key = ec.generate_private_key(ec.SECP256R1())
-        request_id = self.doorman.submit_request(
-            build_csr(self.common_name, key))
+        if os.path.exists(pending_path):
+            with open(pending_path) as f:
+                saved = json.load(f)
+            request_id = saved["request_id"]
+            key = serialization.load_pem_private_key(
+                saved["key_pem"].encode(), password=None)
+        else:
+            key = ec.generate_private_key(ec.SECP256R1())
+            request_id = self.doorman.submit_request(
+                build_csr(self.common_name, key))
+            key_pem = key.private_bytes(
+                serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption()).decode()
+            with open(pending_path, "w") as f:
+                json.dump({"request_id": request_id, "key_pem": key_pem}, f)
         chain = None
         for _ in range(self.max_polls):
             chain = self.doorman.retrieve(request_id)
@@ -162,7 +183,8 @@ class NetworkRegistrationHelper:
         if chain is None:
             raise RegistrationError(
                 f"certificate for {self.common_name!r} not signed after "
-                f"{self.max_polls} polls (pending approval?)")
+                f"{self.max_polls} polls (pending approval? re-run "
+                f"register() to resume request {request_id})")
         node_pem, ca_pem = chain
         with open(key_path, "wb") as f:
             f.write(key.private_bytes(
@@ -173,4 +195,5 @@ class NetworkRegistrationHelper:
         with open(os.path.join(self.node_directory, "tls-ca.crt"),
                   "wb") as f:
             f.write(ca_pem)
+        os.remove(pending_path)
         return cert_path, key_path

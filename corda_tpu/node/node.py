@@ -142,6 +142,12 @@ class Node:
                              advertised_services=services)
         self.services = ServiceHub(self.info, self.messaging,
                                    key_pairs=[self.key_pair])
+        # fresh (confidential-identity) keys must survive restarts, or the
+        # vault replay below would drop states they own as irrelevant
+        from .services import KeyManagementService
+        self.services.key_management = KeyManagementService(
+            [self.key_pair],
+            store_path=os.path.join(config.base_directory, "fresh-keys.jsonl"))
         # durable storage on the kvlog engine (native C++ when built, the
         # format-identical Python engine otherwise) — transactions AND
         # checkpoints persist together, or resumed flows would reference
@@ -323,10 +329,13 @@ class Node:
 
     def _on_client_unreachable(self, recipient: str) -> None:
         """Transport gave up on this address: drop all its feeds so dead
-        clients do not leak subscriptions (disconnect cleanup)."""
+        clients do not leak subscriptions (disconnect cleanup), and error
+        any flow session awaiting that peer (a parked flow must not wait
+        forever on a dead counterparty)."""
         for feed_id in list(self._client_feeds.get(recipient, ())):
             self._unsubscribe_feed(feed_id)
         self._client_feeds.pop(recipient, None)
+        self.smm.on_peer_unreachable(recipient)
 
     def _dispatch_rpc(self, req: RpcRequest):
         if req.method == "unsubscribe_feed":

@@ -7,6 +7,8 @@ attachments, the verifier service, and (when started) the state machine.
 """
 from __future__ import annotations
 
+import json
+import os
 import threading
 from dataclasses import dataclass
 
@@ -176,10 +178,38 @@ class DurableTransactionStorage(TransactionStorage):
 
 class KeyManagementService:
     """Signing keys + fresh-key generation
-    (PersistentKeyManagementService / E2ETestKeyManagementService analog)."""
+    (PersistentKeyManagementService / E2ETestKeyManagementService analog).
 
-    def __init__(self, key_pairs=()):
+    ``store_path`` makes fresh (confidential-identity) keys DURABLE: each
+    generated/added pair is appended to the store and reloaded on
+    construction — without it a restarted node would filter its own
+    fresh-key-owned vault states out as irrelevant (review r3)."""
+
+    def __init__(self, key_pairs=(), store_path: str | None = None):
         self._keys: dict[PublicKey, KeyPair] = {kp.public: kp for kp in key_pairs}
+        self._store_path = store_path
+        if store_path is not None and os.path.exists(store_path):
+            from ..core.crypto.keys import PrivateKey
+            from ..core.crypto.schemes import scheme_by_id
+            with open(store_path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    sid, priv_hex, pub_hex = json.loads(line)
+                    scheme = scheme_by_id(sid)
+                    kp = KeyPair(PublicKey(scheme, bytes.fromhex(pub_hex)),
+                                 PrivateKey(scheme, bytes.fromhex(priv_hex)))
+                    self._keys[kp.public] = kp
+
+    def _persist(self, kp: KeyPair) -> None:
+        if self._store_path is None:
+            return
+        with open(self._store_path, "a") as f:
+            f.write(json.dumps([kp.public.scheme.scheme_number_id,
+                                kp.private.encoded.hex(),
+                                kp.public.encoded.hex()]) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
 
     @property
     def keys(self) -> set[PublicKey]:
@@ -190,9 +220,12 @@ class KeyManagementService:
         from ..core.crypto.schemes import DEFAULT_SIGNATURE_SCHEME
         kp = generate_keypair(scheme or DEFAULT_SIGNATURE_SCHEME)
         self._keys[kp.public] = kp
+        self._persist(kp)
         return kp
 
     def add(self, kp: KeyPair) -> None:
+        if kp.public not in self._keys:
+            self._persist(kp)
         self._keys[kp.public] = kp
 
     def key_pair(self, key: PublicKey) -> KeyPair:

@@ -398,6 +398,22 @@ class StateMachineManager:
         self.hub.network_service.send(
             TopicSession(TOPIC_P2P), serialize(message), str(party.name))
 
+    def on_peer_unreachable(self, peer_name: str) -> None:
+        """Transport-level delivery failure (the TCP plane's
+        on_send_failure hook): every live session toward that peer errors,
+        waking parked flows with a FlowException at their yield site — the
+        analog of the reference's undeliverable-message surfacing. Without
+        this a flow awaiting a dead peer's reply parks forever."""
+        for fsm in list(self.flows.values()):
+            for sess in list(fsm.sessions.values()):
+                if str(sess.peer.name) != str(peer_name) or \
+                        sess.state in ("ended", "errored"):
+                    continue
+                sess.state = "errored"
+                sess.error = FlowException(
+                    f"peer {peer_name} is unreachable")
+                self._maybe_deliver(fsm, sess)
+
     # -- inbound dispatch (onSessionMessage, StateMachineManager.kt:307+) ----
     def _on_message(self, msg) -> None:
         sm = deserialize(msg.data)

@@ -339,13 +339,13 @@ class VerifierWorker:
             self._reply(req, self._verify_host(req))
             return
         # device path: queue the EC math now (non-blocking), finish async
-        sig_futures = self.batcher.submit_many(req.signatures)
+        group_future = self.batcher.submit_group(req.signatures)
         if self._pool is None:
             from concurrent.futures import ThreadPoolExecutor
             self._pool = ThreadPoolExecutor(
                 max_workers=self._pool_workers,
                 thread_name_prefix="verifier-worker")
-        self._pool.submit(self._complete_device, req, sig_futures)
+        self._pool.submit(self._complete_device, req, group_future)
 
     def _verify_host(self, req: VerificationRequest) -> str | None:
         try:
@@ -355,11 +355,12 @@ class VerifierWorker:
             return str(e)
 
     def _complete_device(self, req: VerificationRequest,
-                         sig_futures: list) -> None:
+                         group_future) -> None:
         error = None
         try:
-            for (key, _sig, _content), fut in zip(req.signatures, sig_futures):
-                if not fut.result():
+            verdicts = group_future.result()
+            for (key, _sig, _content), ok in zip(req.signatures, verdicts):
+                if not ok:
                     error = (f"Signature by {key.to_string_short()} did not "
                              f"verify")
                     break

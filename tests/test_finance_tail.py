@@ -195,6 +195,54 @@ def test_manual_finality_broadcasts_only_named_recipients(net):
     assert bob.services.storage.get_transaction(stx2.id) is not None
 
 
+# -- durable fresh keys (review r3) ------------------------------------------
+
+def test_fresh_keys_survive_restart(tmp_path):
+    """Confidential-identity keys persist: a KeyManagementService reloaded
+    from its store still owns (and can sign for) pre-crash fresh keys, so
+    vault replay keeps the states they own."""
+    from corda_tpu.node.services import KeyManagementService
+
+    store = str(tmp_path / "fresh-keys.jsonl")
+    kms = KeyManagementService(store_path=store)
+    kp = kms.fresh_key()
+    sig = kms.sign(b"content", kp.public)
+    reloaded = KeyManagementService(store_path=store)
+    assert kp.public in reloaded.keys
+    assert reloaded.sign(b"content", kp.public).bytes == sig.bytes
+
+
+def test_broadcast_reaches_later_recipients_past_a_dead_one(net):
+    """Review r3: one unreachable recipient must not starve the rest — all
+    deliveries are attempted, then the undelivered set surfaces as one
+    error naming the final transaction."""
+    from corda_tpu.flows.api import FlowException
+    from corda_tpu.flows.library import BroadcastTransactionFlow
+
+    network, notary, alice, bob = net
+    carol = network.create_node("O=Carol, L=Rome, C=IT")
+    network.start_nodes()
+    stx = _issue_commodity(alice, notary, owner=bob.party)
+    alice.services.record_transactions(stx)
+    # bob's endpoint drops everything (dead); carol is fine
+    network.bus.transfer_filter = \
+        lambda t: "Bob" not in t.recipient and "Bob" not in t.sender
+    fsm = alice.start_flow(
+        BroadcastTransactionFlow(stx, [bob.party, carol.party]))
+    network.run_network()
+    # the transport notices bob is gone (the TCP plane's on_send_failure →
+    # smm.on_peer_unreachable); the broadcast moves on to carol
+    alice.smm.on_peer_unreachable(str(bob.party.name))
+    for _ in range(40):
+        network.run_network()
+        if fsm.result_future.done():
+            break
+    # carol received it even though bob never acked
+    assert carol.services.storage.get_transaction(stx.id) is not None
+    with pytest.raises(FlowException, match="FINAL but could not"):
+        fsm.result_future.result(timeout=1)
+
+
 # -- CSR enrolment -----------------------------------------------------------
 
 def test_registration_auto_approval(tmp_path):
