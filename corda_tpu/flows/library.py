@@ -112,7 +112,8 @@ class NotaryServiceFlow(FlowLogic):
             # resolve dependencies from the requester, then fully verify
             yield from self.sub_flow(ResolveTransactionsFlow(
                 self.peer, stx=stx))
-            stx.verify(self.service.hub, check_sufficient_signatures=False)
+            self.service.hub.verify_transaction(
+                stx, check_sufficient_signatures=False)
         if not self.service.time_window_checker.is_valid(stx.tx.time_window):
             raise FlowException("Transaction time-window is outside tolerance")
         try:
@@ -283,7 +284,7 @@ class ResolveTransactionsFlow(FlowLogic):
         # topological order: dependencies before dependents
         order = _topological_order(fetched)
         for stx in order:
-            stx.verify(hub, check_sufficient_signatures=False)
+            hub.verify_transaction(stx, check_sufficient_signatures=False)
             hub.record_transactions(stx)
         return [stx.id for stx in order]
 
@@ -341,10 +342,11 @@ class BroadcastTransactionFlow(FlowLogic):
             except FlowException as e:
                 undelivered.append((party, str(e)))
         if undelivered:
-            names = ", ".join(str(p.name) for p, _ in undelivered)
+            detail = "; ".join(f"{p.name}: {reason}"
+                               for p, reason in undelivered)
             raise FlowException(
                 f"transaction {self.stx.id.prefix_chars()} is FINAL but "
-                f"could not be delivered to: {names}")
+                f"could not be delivered to: {detail}")
         return None
 
 
@@ -359,7 +361,8 @@ class NotifyTransactionHandler(FlowLogic):
         req = yield Receive(self.peer, NotifyTxRequest)
         stx = req.unwrap(lambda r: r.stx)
         yield from self.sub_flow(ResolveTransactionsFlow(self.peer, stx=stx))
-        stx.verify(self.service_hub, check_sufficient_signatures=False)
+        self.service_hub.verify_transaction(
+            stx, check_sufficient_signatures=False)
         self.service_hub.record_transactions(stx)
         yield Send(self.peer, b"ack")
         return None

@@ -72,6 +72,13 @@ class DoormanService:
         if len(cns) != 1 or not cns[0].value.strip():
             raise RegistrationError("CSR must carry exactly one common name")
         common_name = cns[0].value
+        # IDEMPOTENT submission: re-submitting the identical CSR (same name,
+        # same key — e.g. a node that crashed between submitting and
+        # persisting its request id) returns the ORIGINAL request id instead
+        # of an error, so enrolment can always resume
+        for rid, (cn, pem) in self._pending.items():
+            if cn == common_name and pem == csr_pem:
+                return rid
         pending_names = {cn for cn, _ in self._pending.values()}
         if common_name in self._issued_names or common_name in pending_names:
             raise RegistrationError(
@@ -160,23 +167,42 @@ class NetworkRegistrationHelper:
         if os.path.exists(cert_path):
             return cert_path, key_path
         if os.path.exists(pending_path):
+            # resume: the key (and possibly the request id) persisted before
+            # any submission, so every crash window replays deterministically
             with open(pending_path) as f:
                 saved = json.load(f)
-            request_id = saved["request_id"]
             key = serialization.load_pem_private_key(
                 saved["key_pem"].encode(), password=None)
+            request_id = saved.get("request_id")
         else:
             key = ec.generate_private_key(ec.SECP256R1())
-            request_id = self.doorman.submit_request(
-                build_csr(self.common_name, key))
             key_pem = key.private_bytes(
                 serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
                 serialization.NoEncryption()).decode()
+            # persist the key BEFORE submitting: the doorman's idempotent
+            # submission returns the same id for the same (name, key) CSR,
+            # so a crash in either order cannot strand the name
             with open(pending_path, "w") as f:
-                json.dump({"request_id": request_id, "key_pem": key_pem}, f)
+                json.dump({"key_pem": key_pem}, f)
+            request_id = None
+        if request_id is None:
+            request_id = self.doorman.submit_request(
+                build_csr(self.common_name, key))
+            with open(pending_path) as f:
+                saved = json.load(f)
+            saved["request_id"] = request_id
+            with open(pending_path, "w") as f:
+                json.dump(saved, f)
         chain = None
         for _ in range(self.max_polls):
-            chain = self.doorman.retrieve(request_id)
+            try:
+                chain = self.doorman.retrieve(request_id)
+            except RegistrationError:
+                # the doorman no longer knows this id (e.g. it restarted
+                # with in-memory state): discard the stale pending request
+                # and start a fresh enrolment instead of being stuck forever
+                os.remove(pending_path)
+                return self.register()
             if chain is not None:
                 break
             time.sleep(self.poll_interval_s)

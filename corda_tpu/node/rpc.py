@@ -305,3 +305,53 @@ class CordaRPCOps:
                         party, "owning_key", None):
                 return info
         return None
+
+    # -- delegating aliases (the reference defines these as default methods
+    # on CordaRPCOps itself: CordaRPCOps.kt:74,109-118,147-156,176,187,196) --
+    def state_machines_and_updates(self):
+        return self.state_machines_feed()
+
+    def vault_and_updates(self):
+        return self.vault_feed()
+
+    def verified_transactions(self):
+        return self.verified_transactions_feed()
+
+    def state_machine_recorded_transaction_mapping(self):
+        return self.state_machine_recorded_transaction_mapping_feed()
+
+    def network_map_updates(self):
+        return self.network_map_feed()
+
+    @staticmethod
+    def _typed_criteria(state_type):
+        from .query import VaultQueryCriteria
+        return (None if state_type is None
+                else VaultQueryCriteria(contract_state_types=(state_type,)))
+
+    def vault_query_by_criteria(self, criteria, state_type: type | None = None):
+        typed = self._typed_criteria(state_type)
+        if typed is not None:
+            criteria = typed if criteria is None else (criteria & typed)
+        return self.vault_query_by(criteria)
+
+    def vault_query_by_with_paging_spec(self, criteria, paging):
+        return self.vault_query_by(criteria, paging=paging)
+
+    def vault_query_by_with_sorting(self, criteria, sorting):
+        return self.vault_query_by(criteria, sorting=sorting)
+
+    def vault_track(self, state_type: type | None = None):
+        return self.vault_track_by(self._typed_criteria(state_type))
+
+    def vault_track_by_criteria(self, criteria):
+        return self.vault_track_by(criteria)
+
+    def vault_track_by_with_paging_spec(self, criteria, paging):
+        return self.vault_track_by(criteria, paging=paging)
+
+    def vault_track_by_with_sorting(self, criteria, sorting):
+        return self.vault_track_by(criteria, sorting=sorting)
+
+    def party_from_x500_name(self, name):
+        return self.well_known_party_from_x500_name(name)

@@ -323,6 +323,29 @@ class ServiceHub:
             return None
         return wtx.outputs[ref.index]
 
+    # -- verification (the TransactionVerifierService seam) ------------------
+    def verify_transaction(self, stx,
+                           check_sufficient_signatures: bool = True) -> None:
+        """Verify through the node's configured TransactionVerifierService
+        (Services.kt:544-550): with the TPU backend the signature EC math
+        rides the device batcher ACROSS concurrently-verifying flows; other
+        backends (or none) fall back to synchronous host verification.
+        This is the call flows make — the seam the reference routes through
+        `services.transactionVerifierService`."""
+        svc = self.verifier_service
+        # ONLY services whose futures resolve OFF the node thread may be
+        # awaited here: flows run on the single SerialExecutor, and e.g.
+        # the OutOfProcess service's responses arrive on that same executor
+        # — blocking on its future from a flow would deadlock the node
+        if svc is not None and hasattr(svc, "verify_signed") and \
+                getattr(svc, "resolves_off_node_thread", False):
+            svc.verify_signed(
+                stx, self,
+                check_sufficient_signatures=check_sufficient_signatures
+            ).result()
+            return
+        stx.verify(self, check_sufficient_signatures=check_sufficient_signatures)
+
     # -- ledger recording (ServiceHub.recordTransactions) --------------------
     def record_transactions(self, *stxs) -> None:
         # vault updates land before ledger-commit waiters wake, so a resumed

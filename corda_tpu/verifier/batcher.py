@@ -21,7 +21,7 @@ from __future__ import annotations
 import os
 import threading
 from concurrent.futures import Future
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..core.crypto import ecmath
 from ..core.crypto.keys import PublicKey, sec1_decompress_cached

@@ -74,6 +74,10 @@ class TpuTransactionVerifierService(TransactionVerifierService):
     (SignedTransaction.kt:174-178).
     """
 
+    #: safe to block a flow on: the batcher + pool resolve on their own
+    #: threads, never via the node's serial executor (hub.verify_transaction)
+    resolves_off_node_thread = True
+
     def __init__(self, workers: int = 4, batcher: SignatureBatcher | None = None,
                  metrics: MetricRegistry | None = None, mesh=None):
         self.metrics = metrics if metrics is not None else MetricRegistry()

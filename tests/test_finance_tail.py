@@ -295,6 +295,43 @@ def test_registration_manual_approval_and_rejections(tmp_path):
         doorman.submit_request(b"not a csr")
 
 
+def test_registration_survives_doorman_restart_and_crash_windows(tmp_path):
+    """Review r3: a poll timeout, a crash between submit and persist, or a
+    doorman restart must never strand the name — submission is idempotent
+    per (name, key) and a stale request id restarts enrolment."""
+    from corda_tpu.network.registration import (DoormanService,
+                                                NetworkRegistrationHelper,
+                                                RegistrationError)
+    import os
+
+    # timeout, then resume with the SAME pending request on a later call
+    doorman = DoormanService(str(tmp_path / "ca"), auto_approve=False)
+    helper = NetworkRegistrationHelper(
+        str(tmp_path / "node"), "O=R, L=Oslo, C=NO", doorman,
+        poll_interval_s=0.01, max_polls=2)
+    with pytest.raises(RegistrationError, match="not signed"):
+        helper.register()
+    assert os.path.exists(str(tmp_path / "node" / "enrolment-request.json"))
+    (request_id,) = list(doorman._pending)
+    doorman.approve(request_id)              # late operator approval
+    cert_path, _ = helper.register()         # resumes, installs
+    assert os.path.exists(cert_path)
+    assert not os.path.exists(
+        str(tmp_path / "node" / "enrolment-request.json"))
+
+    # doorman restart (in-memory state lost): a fresh helper re-enrols
+    doorman2 = DoormanService(str(tmp_path / "ca"), auto_approve=False)
+    helper2 = NetworkRegistrationHelper(
+        str(tmp_path / "node2"), "O=R2, L=Oslo, C=NO", doorman2,
+        poll_interval_s=0.01, max_polls=2)
+    with pytest.raises(RegistrationError, match="not signed"):
+        helper2.register()
+    doorman3 = DoormanService(str(tmp_path / "ca"), auto_approve=True)
+    helper2.doorman = doorman3               # the restarted doorman
+    cert2, _ = helper2.register()            # stale id -> fresh enrolment
+    assert os.path.exists(cert2)
+
+
 def test_registration_timeout_when_never_approved(tmp_path):
     from corda_tpu.network.registration import (DoormanService,
                                                 NetworkRegistrationHelper,

