@@ -152,6 +152,31 @@ def test_ecdsa_verify_batch(curve, mode):
     assert want[0] and not all(want)
 
 
+def test_hybrid_wide_window_widths_agree():
+    """The wide-G ladder must verify identically at every (even) window
+    width ON THE SAME INPUTS — g_w only changes how many bits one
+    constant-table gather consumes, never the result (regression lock on
+    the digit packing)."""
+    curve = ecmath.SECP256K1
+    rng = np.random.default_rng(77)
+    items, want = [], []
+    for i in range(8):
+        priv = int.from_bytes(rng.bytes(32), "little") % (curve.n - 1) + 1
+        pub = curve.mul(priv, curve.g)
+        msg = rng.bytes(24 + i)
+        r, s = ecmath.ecdsa_sign(curve, priv, msg)
+        if i % 3 == 1:
+            msg = msg + b"?"
+        items.append((pub, msg, r, s))
+        want.append(ecmath.ecdsa_verify(curve, pub, msg, r, s))
+    for g_w in (2, 4):
+        *args, precheck = wc_ops.prepare_batch_hybrid_wide(items, g_w)
+        ok = np.asarray(wc_ops._verify_kernel_hybrid_wide(*args, g_w=g_w))
+        assert list(ok & precheck) == want, f"g_w={g_w}"
+    with pytest.raises(ValueError, match="even"):
+        wc_ops.prepare_batch_hybrid_wide(items, 3)
+
+
 def test_ecdsa_rejects_high_s_and_off_curve():
     curve = ecmath.SECP256K1
     priv = rand_scalar(curve.n - 1) + 1
