@@ -87,3 +87,18 @@ def test_bulk_submit_verdicts_match_individual():
         assert [f.result(timeout=30) for f in futs] == [True, False]
     finally:
         b.close()
+
+
+def test_cancelled_future_does_not_wedge_the_dispatcher():
+    """Review r3: a caller cancelling its future must not crash the
+    dispatcher/finisher — later submissions still resolve."""
+    b = SignatureBatcher(host_crossover=0, max_latency_s=0.01)
+    try:
+        doomed = b.submit(KP.public, SIG, CONTENT)
+        doomed.cancel()   # may or may not win the race; either is fine
+        after = b.submit_many([(KP.public, SIG, CONTENT)] * 3)
+        assert all(f.result(timeout=120) for f in after)
+        assert all(b.submit_group([(KP.public, SIG, CONTENT)] * 2)
+                   .result(timeout=120))
+    finally:
+        b.close()
