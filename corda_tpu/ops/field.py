@@ -112,21 +112,31 @@ def _pass(v, bounds):
     return _trim(v, nb)
 
 
-def _fold_once(v, bounds, c_limbs):
-    """lo + hi·c for a width>16 value (split at bit 256). Exact bounds."""
-    lo, lob = v[..., :NLIMB], bounds[:NLIMB]
-    hi, hib = v[..., NLIMB:], bounds[NLIMB:]
-    nh = len(hib)
-    acc_w = max(NLIMB, nh + len(c_limbs))
-    acc = jnp.zeros(v.shape[:-1] + (acc_w,), dtype=jnp.uint64)
-    acc = acc.at[..., :NLIMB].add(lo)
+def _fold_bounds(bounds, c_limbs):
+    """Exact post-fold bounds, or None when a fold would overflow u64."""
+    lob, hib = bounds[:NLIMB], bounds[NLIMB:]
+    acc_w = max(NLIMB, len(hib) + len(c_limbs))
     nb = list(lob) + [0] * (acc_w - NLIMB)
     for j, c in enumerate(c_limbs):
         if c:
-            acc = acc.at[..., j:j + nh].add(hi * jnp.uint64(c))
             for i, hb in enumerate(hib):
                 nb[j + i] += hb * c
-    assert max(nb) < (1 << 63), "u64 column overflow"
+    return nb if max(nb) < (1 << 63) else None
+
+
+def _fold_once(v, bounds, c_limbs):
+    """lo + hi·c for a width>16 value (split at bit 256). Exact bounds."""
+    lo = v[..., :NLIMB]
+    hi, hib = v[..., NLIMB:], bounds[NLIMB:]
+    nh = len(hib)
+    nb = _fold_bounds(bounds, c_limbs)
+    assert nb is not None, "u64 column overflow"
+    acc_w = max(NLIMB, nh + len(c_limbs))
+    acc = jnp.zeros(v.shape[:-1] + (acc_w,), dtype=jnp.uint64)
+    acc = acc.at[..., :NLIMB].add(lo)
+    for j, c in enumerate(c_limbs):
+        if c:
+            acc = acc.at[..., j:j + nh].add(hi * jnp.uint64(c))
     return _trim(acc, nb)
 
 
@@ -134,24 +144,32 @@ def _normalize(v, bounds, p: int):
     """Carry/fold until the element meets the 16-limb contract. All control
     flow is host-side over exact bounds; terminates because folds strictly
     shrink the value bound and the terminal width-17/limb16≤tiny state folds
-    back into limb 15's headroom."""
+    back into limb 15's headroom.
+
+    Folds run EAGERLY — as soon as the exact post-fold bounds fit u64 —
+    instead of after carrying every limb below LMAX first: an early fold
+    shrinks the array from up-to-31 limbs to ~16, so the remaining carry
+    passes run at half the width (measured 4 passes + 2 folds per norm
+    before; the wide passes dominated the walk cost)."""
     c_limbs = _c_limbs_of(p)
     for _ in range(64):
-        # carry passes until every limb is under the uniform pass target
-        while any(b > LMAX - 1 for b in bounds):
-            v, bounds = _pass(v, bounds)
-        if len(bounds) == NLIMB:
-            assert all(b <= t for b, t in zip(bounds, _CONTRACT))
+        if len(bounds) > NLIMB:
+            if (len(bounds) == NLIMB + 1
+                    and bounds[15] + (bounds[16] << LIMB_BITS) < LIMB15_MAX):
+                # fold limb 16 back into limb 15's headroom: value-preserving
+                merged = v[..., 15] + (v[..., 16] << LIMB_BITS)
+                v = v[..., :NLIMB].at[..., 15].set(merged)
+                bounds = bounds[:15] + [bounds[15] + (bounds[16] << LIMB_BITS)]
+                continue
+            nb = _fold_bounds(bounds, c_limbs)
+            if nb is not None:
+                v, bounds = _fold_once(v, bounds, c_limbs)
+            else:
+                v, bounds = _pass(v, bounds)
+            continue
+        if all(b <= t for b, t in zip(bounds, _CONTRACT)):
             return v, bounds
-        if (len(bounds) == NLIMB + 1
-                and bounds[15] + (bounds[16] << LIMB_BITS) < LIMB15_MAX):
-            # fold limb 16 back into limb 15's headroom: value-preserving
-            merged = v[..., 15] + (v[..., 16] << LIMB_BITS)
-            v = v[..., :NLIMB].at[..., 15].set(merged)
-            bounds = bounds[:15] + [bounds[15] + (bounds[16] << LIMB_BITS)]
-            assert all(b <= t for b, t in zip(bounds, _CONTRACT))
-            return v, bounds
-        v, bounds = _fold_once(v, bounds, c_limbs)
+        v, bounds = _pass(v, bounds)
     raise AssertionError("field normalization failed to converge")
 
 
@@ -403,8 +421,39 @@ def col_acc(p: int, plus=(), minus=()):
     return (out, nb_out)
 
 
+def raw_sqr_bounded(a, bounds):
+    """Triangular schoolbook square: col_k = 2·Σ_{i<j, i+j=k} a_i·a_j +
+    [k even]·a_{k/2}² — ~n(n+1)/2 column MACs instead of n² (the u64 lane
+    multiply dominates product cost, so squares run ~40% cheaper than
+    general products; `dbl`'s Y² / Z² and Fermat's square chain are the
+    beneficiaries). Bounds are identical to the general product's."""
+    n = len(bounds)
+    a2 = a * jnp.uint64(2)
+    cols = jnp.zeros(a.shape[:-1] + (2 * n - 1,), dtype=jnp.uint64)
+    # row i covers columns [2i, i+n): the diagonal a_i² then doubled cross
+    # terms a_i·2a_j (j > i) — CONTIGUOUS slice updates (a strided
+    # cols[0::2] diagonal scatter forces a relayout on TPU)
+    for i in range(n):
+        seg = jnp.concatenate([a[..., i:i + 1], a2[..., i + 1:]], axis=-1)
+        cols = cols.at[..., 2 * i: i + n].add(a[..., i:i + 1] * seg)
+    nb = [0] * (2 * n - 1)
+    for i, ab in enumerate(bounds):
+        for j, bb in enumerate(bounds):
+            nb[i + j] += ab * bb
+    assert max(nb) < (1 << 63), "u64 column overflow in squared schoolbook"
+    return cols, nb
+
+
+def sqr_cols(ar):
+    """Triangular square of a relaxed pair → raw (cols, bounds), NO
+    normalize — the squared sibling of :func:`mul_cols`."""
+    a, ab = ar if isinstance(ar, tuple) else rel(ar)
+    return raw_sqr_bounded(a, ab)
+
+
 def sqr(a, p: int):
-    return mul(a, a, p)
+    cols, nb = raw_sqr_bounded(a, _CONTRACT)
+    return _normalize(cols, nb, p)[0]
 
 
 _CONTRACT2 = [2 * c for c in _CONTRACT]
@@ -422,8 +471,7 @@ def mul_of_sums(a1, a2, b1, b2, p: int):
 
 def sqr_of_sum(a1, a2, p: int):
     """(a1+a2)² mod p without normalizing the sum."""
-    s = a1 + a2
-    cols, nb = raw_mul_bounded(s, s, _CONTRACT2, _CONTRACT2)
+    cols, nb = raw_sqr_bounded(a1 + a2, _CONTRACT2)
     return _normalize(cols, nb, p)[0]
 
 

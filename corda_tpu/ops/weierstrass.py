@@ -58,10 +58,17 @@ def _points_to_limbs(col):
     """Affine host points [(x, y)] → projective limb triple with Z = 1.
     Ships u16 (canonical 16-bit limbs); kernels upcast on device — u64 on
     the wire was 4x the transfer bytes for no information."""
-    px = jnp.asarray(F.to_limbs([pt[0] for pt in col]).astype(np.uint16))
-    py = jnp.asarray(F.to_limbs([pt[1] for pt in col]).astype(np.uint16))
+    px, py = _points_to_limbs_affine(col)
     pz = jnp.zeros_like(px).at[..., 0].set(1)
     return (px, py, pz)
+
+
+def _points_to_limbs_affine(col):
+    """Affine host points [(x, y)] → (X, Y) u16 limb pair — no Z plane on
+    the wire (the hybrid kernel's Q legs are affine; Z = 1 is implied)."""
+    px = jnp.asarray(F.to_limbs([pt[0] for pt in col]).astype(np.uint16))
+    py = jnp.asarray(F.to_limbs([pt[1] for pt in col]).astype(np.uint16))
+    return (px, py)
 
 
 def _add_k1(Pt, Qt, p: int, b3: int):
@@ -103,6 +110,40 @@ def _add_k1(Pt, Qt, p: int, b3: int):
     return (X3, Y3, Z3)
 
 
+def _madd_k1(Pt, Qa, p: int, b3: int):
+    """Fused RCB complete MIXED addition (Z2 = 1) for a = 0, small b3
+    (secp256k1): the affine addend kills the Z1·Z2 product and t2's walk —
+    11 products / 9 walks vs :func:`_add_k1`'s 12 / 10. Complete for every
+    projective P1 (identity included); NOT valid for an identity addend —
+    the ladder's constant-G table carries a validity flag and the caller
+    selects the untouched accumulator for flagged-identity rows instead.
+
+    With Z2 = 1 the RCB cross terms collapse on the host side:
+    t2 = Z1, t4 = X1 + Z1·X2, t5 = Y1 + Z1·Y2."""
+    X1, Y1, Z1 = Pt
+    X2, Y2 = Qa
+    c0 = F.mul_cols(X1, X2)
+    c1 = F.mul_cols(Y1, Y2)
+    t1 = F.norm(c1, p)
+    t0x3 = F.norm(F.scale_cols(c0, 3), p)              # 3·t0
+    t3 = F.norm(F.col_acc(p, plus=[F.mul_cols(F.rel_add(X1, Y1),
+                                              F.rel_add(X2, Y2))],
+                          minus=[c0, c1]), p)
+    t4b3 = F.norm(F.scale_cols(
+        F.col_acc(p, plus=[F.mul_cols(Z1, X2), F.rel(X1)]), b3), p)
+    t5 = F.norm(F.col_acc(p, plus=[F.mul_cols(Z1, Y2), F.rel(Y1)]), p)
+    bt2 = F.mul_const(Z1, b3, p)
+    Xm = F.rel_sub(t1, bt2, p)       # t1 - b3·t2, relaxed
+    Zm = F.rel_add(t1, bt2)          # t1 + b3·t2, relaxed
+    Y3 = F.norm(F.col_acc(p, plus=[F.mul_cols(Xm, Zm),
+                                   F.mul_cols(t0x3, t4b3)]), p)
+    X3 = F.norm(F.col_acc(p, plus=[F.mul_cols(t3, Xm)],
+                          minus=[F.mul_cols(t5, t4b3)]), p)
+    Z3 = F.norm(F.col_acc(p, plus=[F.mul_cols(t5, Zm),
+                                   F.mul_cols(t3, t0x3)]), p)
+    return (X3, Y3, Z3)
+
+
 def add(Pt, Qt, curve: WeierstrassCurve):
     """RCB16 complete projective addition, specialized at trace time.
 
@@ -115,8 +156,9 @@ def add(Pt, Qt, curve: WeierstrassCurve):
       subtraction — 12 full muls + cheap constant muls.
     - general a: Algorithm 1 verbatim.
     """
+    doubling = Pt is Qt     # dbl-via-add: every cross product is a square
     Pt = tuple(jnp.asarray(c, jnp.uint64) for c in Pt)
-    Qt = tuple(jnp.asarray(c, jnp.uint64) for c in Qt)
+    Qt = Pt if doubling else tuple(jnp.asarray(c, jnp.uint64) for c in Qt)
     p = curve.p
     a = curve.a % p
     b3 = 3 * curve.b % p
@@ -129,18 +171,25 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     def mul_b3(x):
         return F.mul_const(x, b3, p) if b3_c is None else F.mul(x, b3_c, p)
 
+    def mul2(x, y):
+        return F.sqr(x, p) if doubling else F.mul(x, y, p)
+
+    def mul2_of_sums(a1, a2, b1, b2):
+        return (F.sqr_of_sum(a1, a2, p) if doubling
+                else F.mul_of_sums(a1, a2, b1, b2, p))
+
     X1, Y1, Z1 = Pt
     X2, Y2, Z2 = Qt
-    t0 = F.mul(X1, X2, p)
-    t1 = F.mul(Y1, Y2, p)
-    t2 = F.mul(Z1, Z2, p)
-    t3 = F.mul_of_sums(X1, Y1, X2, Y2, p)
+    t0 = mul2(X1, X2)
+    t1 = mul2(Y1, Y2)
+    t2 = mul2(Z1, Z2)
+    t3 = mul2_of_sums(X1, Y1, X2, Y2)
     t4 = F.add(t0, t1, p)
     t3 = F.sub(t3, t4, p)
-    t4 = F.mul_of_sums(X1, Z1, X2, Z2, p)
+    t4 = mul2_of_sums(X1, Z1, X2, Z2)
     t5 = F.add(t0, t2, p)
     t4 = F.sub(t4, t5, p)
-    t5 = F.mul_of_sums(Y1, Z1, Y2, Z2, p)
+    t5 = mul2_of_sums(Y1, Z1, Y2, Z2)
     X3 = F.add(t1, t2, p)
     t5 = F.sub(t5, X3, p)
     if neg_a < small:
@@ -200,9 +249,9 @@ def dbl(Pt, curve: WeierstrassCurve):
     if a != 0 or b3 >= F.MUL_CONST_MAX:
         return add(Pt, Pt, curve)
     X, Y, Z = Pt
-    cy = F.mul_cols(Y, Y)
+    cy = F.sqr_cols(Y)
     s = F.norm(cy, p)                                   # Y²
-    w = F.norm(F.scale_cols(F.mul_cols(Z, Z), b3), p)   # b3·Z²
+    w = F.norm(F.scale_cols(F.sqr_cols(Z), b3), p)      # b3·Z²
     xy = F.norm(F.mul_cols(X, Y), p)
     yz = F.norm(F.mul_cols(Y, Z), p)
     sm3w = F.rel_sub(s, F.scale_rel(w, 3), p)           # s - 3w, relaxed
@@ -235,8 +284,10 @@ def shamir_ladder(bits1, bits2, P1, P2, curve: WeierstrassCurve):
 # GLV path (secp256k1 only): 4-scalar joint ladder over 129 bits
 # ---------------------------------------------------------------------------
 
-GLV_BITS = 130  # |k1|,|k2| < 2^128 with small constant slack; int.to_bytes
-                # raises OverflowError if a decomposition ever exceeded this
+GLV_BITS = 128  # Babai rounding bounds the decomposition halves by
+                # (|a1|+|a2|)/2 < 2^127.35 and (|b1|+|b2|)/2 < 2^127.12
+                # (ecmath constants), so 128 bits always suffice;
+                # scalars_to_bits asserts if a scalar ever exceeded this
 
 
 def glv_ladder(bits4, pts4, curve: WeierstrassCurve):
@@ -279,6 +330,17 @@ def _accept(X, Z, r_cands, p):
     nonzero = ~F.is_zero(Z, p)
     ok_r = (F.eq(X, F.mul(r_cands[0], Z, p), p)
             | F.eq(X, F.mul(r_cands[1], Z, p), p))
+    return nonzero & ok_r
+
+
+def _accept_rn(X, Z, r, rn_ok, p: int, n: int):
+    """Like :func:`_accept`, but the second x-candidate (r + n, valid only
+    when it stays below p) is DERIVED on device from r and a 1-bit flag —
+    half the candidate wire bytes of shipping both limb arrays."""
+    nonzero = ~F.is_zero(Z, p)
+    r1 = F.add(r, jnp.broadcast_to(jnp.asarray(F.to_limbs(n)), r.shape), p)
+    ok_r = (F.eq(X, F.mul(r, Z, p), p)
+            | (rn_ok & F.eq(X, F.mul(r1, Z, p), p)))
     return nonzero & ok_r
 
 
@@ -386,38 +448,57 @@ def prepare_batch_glv(items):
 # ---------------------------------------------------------------------------
 
 def _q_window_table(Qc, Qd, curve: WeierstrassCurve):
-    """16-entry per-item table T[i + 4j] = [i]Qc + [j]Qd (i, j ∈ [0,4)):
-    2 doublings + 12 complete adds, one-time per batch."""
+    """16-entry per-item table T[i + 4j] = [i]Qc + [j]Qd (i, j ∈ [0,4)) from
+    AFFINE Qc = (x, y), Qd = (x, y): 2 doublings + 11 complete MIXED adds
+    (each affine operand saves a product and a walk vs the projective
+    chain), one-time per batch. No exception analysis needed: _madd_k1 is
+    complete for every projective P1 given a valid affine P2 ≠ ∞, and the
+    host precheck substitutes G for any malformed key."""
+    p = curve.p
+    b3 = 3 * curve.b % p
+    one = F.one_like(Qc[0])
     batch_shape = Qc[0].shape[:-1]
     T = [identity(batch_shape)] * 16
-    T[1] = Qc
-    T[2] = dbl(Qc, curve)
-    T[3] = add(T[2], Qc, curve)
-    T[4] = Qd
-    T[8] = dbl(Qd, curve)
-    T[12] = add(T[8], Qd, curve)
+    T[1] = (Qc[0], Qc[1], one)
+    T[2] = dbl(T[1], curve)
+    T[3] = _madd_k1(T[2], Qc, p, b3)
+    T[4] = (Qd[0], Qd[1], one)
+    T[8] = dbl(T[4], curve)
+    T[12] = _madd_k1(T[8], Qd, p, b3)
     for j in (4, 8, 12):
-        for i in (1, 2, 3):
-            T[i + j] = add(T[i], T[j], curve)
+        T[j + 1] = _madd_k1(T[j], Qc, p, b3)
+        T[j + 2] = _madd_k1(T[j + 1], Qc, p, b3)
+        T[j + 3] = _madd_k1(T[j + 2], Qc, p, b3)
     return T
 
 
 #: Default constant-G window width for the hybrid kernel. Measured on v5e
-#: at batch 32k: w=2 36.1k, w=4 41.5k, w=6 44.9k verifies/s (the G table is
-#: a free kernel constant — 2^14 entries at w=6 — so widening trades only
-#: table size for fewer G adds). w=8 would need a 2^18-entry (~100MB) table.
-HYBRID_G_WINDOW = 6
+#: at batch 32k (r4 kernel: affine u16 tables + mixed G adds + GLV 128):
+#: w=6 42.8k, w=8 45.4k verifies/s (medians of 5). The w=8 table is 2^18
+#: affine u16 rows (~17MB baked constants) — 4x less gather footprint than
+#: the u64 projective layout that made w=8 a ~100MB non-starter in r3 —
+#: and 128 = 16x8 divides exactly: 128 dbls, 64 Q adds, 16 G adds.
+HYBRID_G_WINDOW = 8
 
 _G_TABLES_WIDE: dict[tuple, tuple] = {}
 
 
 def _g_window_table_wide(curve: WeierstrassCurve, w: int):
-    """(2^(2w+2), NLIMB)-per-coordinate constant projective table indexed by
+    """AFFINE constant-G window table: u16 X/Y limb arrays of shape
+    (2^(2w+2), NLIMB) plus a u8 validity flag, indexed by
     ``wa + 2^w·wb + 2^(2w)·sa + 2^(2w+1)·sb``: entry = wa·(sa ? -G : G) +
     wb·(sb ? -phi(G) : phi(G)) for w-bit digits wa, wb ∈ [0, 2^w).
-    Identity rows are (0 : 1 : 0). Pure curve constants → baked into the
-    kernel; widening w trades (free) table size for FEWER G adds in the
-    ladder: one G add per w bits instead of per 2."""
+
+    Affine entries let the ladder use the cheaper complete MIXED add
+    (:func:`_madd_k1`); identity entries (wa = wb = 0) carry flag 0 and the
+    ladder selects the untouched accumulator for them. u16 storage is 4x
+    less gather footprint than u64 — at w = 8 the three arrays are ~17MB.
+
+    The build batch-inverts every chord denominator with ONE modpow
+    (Montgomery's trick) so even the 2^17 affine adds at w = 8 take ~1s,
+    one-time per process. wa·G = ±wb·phi(G) is impossible for nonzero
+    digits (it would force wa ≡ ∓wb·lambda (mod n) with tiny wa, wb), so
+    every chord add is generic — asserted, not assumed."""
     key = (curve.name, w)
     if key in _G_TABLES_WIDE:
         return _G_TABLES_WIDE[key]
@@ -435,81 +516,150 @@ def _g_window_table_wide(curve: WeierstrassCurve, w: int):
     g_mult = multiples(g)
     phi_mult = multiples(phi)
 
-    def neg(pt):
-        return None if pt is None else (pt[0], (p - pt[1]) % p)
+    # One inverse chord slope denominator per (wa, wb) pair, shared by both
+    # relative-sign grids (x(-P) = x(P)).
+    dens = []
+    for wb in range(1, span):
+        xb = phi_mult[wb][0]
+        for wa in range(1, span):
+            d = (xb - g_mult[wa][0]) % p
+            assert d != 0, "G/phi(G) multiples can never share an x"
+            dens.append(d)
+    invs = iter(_batch_modinv(dens, p))
 
-    xs, ys, zs = [], [], []
+    # grid_pp[wb][wa] = wa·G + wb·phi(G); grid_pm: wa·G - wb·phi(G).
+    grid_pp = [[None] * span for _ in range(span)]
+    grid_pm = [[None] * span for _ in range(span)]
+    grid_pp[0] = list(g_mult)
+    grid_pm[0] = list(g_mult)
+    for wb in range(1, span):
+        xb, yb = phi_mult[wb]
+        grid_pp[wb][0] = (xb, yb)
+        grid_pm[wb][0] = (xb, (p - yb) % p)
+        for wa in range(1, span):
+            xa, ya = g_mult[wa]
+            inv = next(invs)
+            for grid, y2 in ((grid_pp, yb), (grid_pm, p - yb)):
+                lam = (y2 - ya) * inv % p
+                x3 = (lam * lam - xa - xb) % p
+                grid[wb][wa] = (x3, (lam * (xa - x3) - ya) % p)
+
+    xs, ys, flags = [], [], []
     for sb in (False, True):
         for sa in (False, True):
+            # (sa, sb) grid: negate-both maps (+,+)↔(-,-) and (+,-)↔(-,+)
+            grid, flip = ((grid_pp, sa) if sa == sb else (grid_pm, sa))
             for wb in range(span):
                 for wa in range(span):
-                    a_pt = neg(g_mult[wa]) if sa else g_mult[wa]
-                    b_pt = neg(phi_mult[wb]) if sb else phi_mult[wb]
-                    if a_pt is None and b_pt is None:
-                        pt, is_id = (0, 1), True
-                    elif a_pt is None:
-                        pt, is_id = b_pt, False
-                    elif b_pt is None:
-                        pt, is_id = a_pt, False
+                    pt = grid[wb][wa]
+                    if pt is None:               # wa = wb = 0: identity
+                        xs.append(0)
+                        ys.append(0)
+                        flags.append(0)
                     else:
-                        pt, is_id = curve.add(a_pt, b_pt), False
-                        if pt is None:       # wa·(±G) = -(wb·(±phi G))
-                            pt, is_id = (0, 1), True
-                    xs.append(pt[0])
-                    ys.append(pt[1])
-                    zs.append(0 if is_id else 1)
-    tab = tuple(F.to_limbs(v) for v in (xs, ys, zs))
+                        x, y = pt
+                        xs.append(x)
+                        ys.append((p - y) % p if flip and y else y)
+                        flags.append(1)
+    tab = (F.to_limbs(xs).astype(np.uint16), F.to_limbs(ys).astype(np.uint16),
+           np.asarray(flags, dtype=np.uint8))
     _G_TABLES_WIDE[key] = tab
     return tab
 
 
-def hybrid_ladder_wide(g_idx, q_bits, Qc, Qd, curve: WeierstrassCurve,
+_G_TABLES_DEV: dict[tuple, tuple] = {}
+
+
+def g_window_table_device(curve: WeierstrassCurve, w: int):
+    """The affine constant-G table as COMMITTED DEVICE ARRAYS. The table is
+    passed to the kernel as arguments, NOT baked in as constants: at w = 8
+    the baked-constant form put ~35MB of literals in the HLO, blowing
+    compile time to minutes per process (fatal for CPU test runs). As
+    committed jax Arrays the upload happens once per process and repeat
+    calls pass the same buffers — same zero-transfer steady state."""
+    key = (curve.name, w)
+    if key not in _G_TABLES_DEV:
+        _G_TABLES_DEV[key] = tuple(
+            jax.device_put(t) for t in _g_window_table_wide(curve, w))
+    return _G_TABLES_DEV[key]
+
+
+def hybrid_ladder_wide(g_idx, q_bits, Qc, Qd, gtab, curve: WeierstrassCurve,
                        g_w: int):
     """The hybrid ladder with a WIDER constant-G window: per outer step,
     ``g_w`` bits are consumed — g_w doublings, g_w/2 Q adds (2-bit per-item
-    windows, unchanged), and ONE G add from the 2^(2·g_w+2)-entry constant
-    table. Fewer G adds per bit is free compute: the table is a kernel
-    constant, only the ladder shrinks.
+    windows, unchanged), and ONE mixed G add gathered from the affine
+    2^(2·g_w+2)-entry table ``gtab`` (see g_window_table_device). Fewer G
+    adds per bit is nearly free compute: only the ladder shrinks.
 
-    ``g_idx``: (W_g, B) table indices; ``q_bits``: (W_g, g_w//2, B, 4).
+    ``g_idx``: (W_g, B) table indices; ``q_bits``: (W_g, g_w//2, B) packed
+    joint Q digits (wc | wd<<2); ``gtab``: (tab_x, tab_y, tab_ok) arrays.
     """
-    batch_shape = Qc[0].shape[:-1]
-    Pid = identity(batch_shape)
     table = _q_window_table(Qc, Qd, curve)
-    gtab = tuple(jnp.asarray(t) for t in _g_window_table_wide(curve, g_w))
+    tab_x, tab_y, tab_ok = gtab
+    p = curve.p
+    b3 = 3 * curve.b % p
 
     def q_addend(qb):
+        """qb: (B,) packed joint digit wc | wd<<2 — 4 table-index bits in
+        one u8 on the wire (the unpacked (B, 4) bit planes were 4x the
+        transfer bytes)."""
         level = table
         for j in range(4):                # fold by index bit j (LSB first)
-            b = qb[..., j].astype(jnp.bool_)
+            b = ((qb >> j) & 1).astype(jnp.bool_)
             level = [tuple(F.select(b, hi_c, lo_c)
                            for lo_c, hi_c in zip(lo, hi))
                      for lo, hi in zip(level[0::2], level[1::2])]
         return level[0]
 
-    def step(acc, ins):
-        gi, qb = ins                      # qb: (g_w//2, B, 4)
-        for t in range(g_w // 2):
-            acc = dbl(dbl(acc, curve), curve)
-            acc = add(acc, q_addend(qb[t]), curve)
-        return add(acc, tuple(t[gi] for t in gtab), curve), None
+    def g_add(acc, gi):
+        """Gather the affine G addend and mixed-add it; identity rows
+        (flag 0) select the untouched accumulator instead."""
+        q2 = (tab_x[gi].astype(jnp.uint64), tab_y[gi].astype(jnp.uint64))
+        added = _madd_k1(acc, q2, p, b3)
+        ok = tab_ok[gi].astype(jnp.bool_)
+        return tuple(F.select(ok, new_c, acc_c)
+                     for new_c, acc_c in zip(added, acc))
 
+    def q_step(acc, qb_t):
+        acc = dbl(dbl(acc, curve), curve)
+        return add(acc, q_addend(qb_t), curve), None
+
+    def step(acc, ins):
+        gi, qb = ins                      # qb: (g_w//2, B)
+        # inner scan instead of unrolling g_w//2 pairs: the unrolled body
+        # made XLA compile time blow up superlinearly with batch size
+        # (157s for a CPU bucket-32 at g_w=8; the nested scan also shrinks
+        # the cache key's HLO)
+        acc, _ = jax.lax.scan(q_step, acc, qb)
+        return g_add(acc, gi), None
+
+    # Peel the first outer step: acc is the identity there, so the leading
+    # dbl-dbl-add collapses to selecting the first Q addend directly
+    # (saves 2 complete dbls + 1 add vs running step 0 through the scan).
+    qb0 = q_bits[0]
+    acc = q_addend(qb0[0])
+    acc, _ = jax.lax.scan(q_step, acc, qb0[1:])
+    acc = g_add(acc, g_idx[0])
     # unroll=2 measured SLOWER here (43.6k vs 44.9k/s on v5e): the wide
     # step body is already 6 dbl + 4 adds — unrolling doubles an already
     # register-heavy body for nothing
-    acc, _ = jax.lax.scan(step, Pid, (g_idx, q_bits))
+    acc, _ = jax.lax.scan(step, acc, (g_idx[1:], q_bits[1:]))
     return acc
 
 
-def verify_core_hybrid_wide(g_idx, q_bits, Qc, Qd, r_cands, g_w: int):
+def verify_core_hybrid_wide(g_idx, q_bits, Qc, Qd, r_limbs, rn_ok,
+                            tab_x, tab_y, tab_ok, g_w: int):
     g_idx = jnp.asarray(g_idx, jnp.int32)
     q_bits = jnp.asarray(q_bits, jnp.uint64)
     Qc = tuple(jnp.asarray(c, jnp.uint64) for c in Qc)
     Qd = tuple(jnp.asarray(c, jnp.uint64) for c in Qd)
-    r_cands = jnp.asarray(r_cands, jnp.uint64)
+    r_limbs = jnp.asarray(r_limbs, jnp.uint64)
+    rn_ok = jnp.asarray(rn_ok).astype(jnp.bool_)
     curve = CURVES["secp256k1"]
-    X, Y, Z = hybrid_ladder_wide(g_idx, q_bits, Qc, Qd, curve, g_w)
-    return _accept(X, Z, r_cands, curve.p)
+    X, Y, Z = hybrid_ladder_wide(g_idx, q_bits, Qc, Qd,
+                                 (tab_x, tab_y, tab_ok), curve, g_w)
+    return _accept_rn(X, Z, r_limbs, rn_ok, curve.p, curve.n)
 
 
 _verify_kernel_hybrid_wide = jax.jit(verify_core_hybrid_wide,
@@ -568,15 +718,15 @@ def prepare_batch_hybrid_wide(items, g_w: int):
              ).astype(np.int32 if g_w > 6 else np.uint16)
     wc = _bits_to_windows(F.scalars_to_bits(cs, nbits))
     wd = _bits_to_windows(F.scalars_to_bits(ds, nbits))
-    q_planes = np.stack([wc & 1, wc >> 1, wd & 1, wd >> 1],
-                        axis=-1).astype(np.uint8)          # (nbits/2, B, 4)
+    q_packed = (wc | (wd << 2)).astype(np.uint8)           # (nbits/2, B)
     n_g = nbits // g_w
-    q_bits = q_planes.reshape(n_g, g_w // 2, *q_planes.shape[1:])
-    r_cands = jnp.asarray(np.stack(
-        [F.to_limbs(r0), F.to_limbs(r1)]).astype(np.uint16))
+    q_bits = q_packed.reshape(n_g, g_w // 2, *q_packed.shape[1:])
+    r_limbs = jnp.asarray(F.to_limbs(r0).astype(np.uint16))
+    rn_ok = jnp.asarray(np.asarray(
+        [r + curve.n < curve.p for r in r0], dtype=np.uint8))
     return (jnp.asarray(g_idx), jnp.asarray(q_bits),
-            _points_to_limbs(qc_pts), _points_to_limbs(qd_pts),
-            r_cands, precheck)
+            _points_to_limbs_affine(qc_pts), _points_to_limbs_affine(qd_pts),
+            r_limbs, rn_ok, *g_window_table_device(curve, g_w), precheck)
 
 
 def verify_core(u1_bits, u2_bits, q_pts, r_cands, curve_name: str):

@@ -87,16 +87,17 @@ def sharded_ecdsa_verify_hybrid(mesh: Mesh):
     (ops.weierstrass.verify_core_hybrid_wide), scaled the same dp way.
 
     Input layout (from ops.weierstrass.prepare_batch_hybrid_wide): g_idx
-    (W_g, B); q_bits (W_g, g_w/2, B, 4); Qc/Qd 3×(B, 16); r_cands
-    (2, B, 16).
+    (W_g, B); q_bits (W_g, g_w/2, B) packed digits; Qc/Qd affine 2×(B, 16);
+    r (B, 16); rn_ok (B,); the constant-G table replicated on every chip.
     """
     core = functools.partial(wc_ops.verify_core_hybrid_wide,
                              g_w=wc_ops.HYBRID_G_WINDOW)
     shmapped = jax.shard_map(
         core, mesh=mesh,
-        in_specs=(P(None, AXIS), P(None, None, AXIS, None),
-                  (P(AXIS, None),) * 3, (P(AXIS, None),) * 3,
-                  P(None, AXIS, None)),
+        in_specs=(P(None, AXIS), P(None, None, AXIS),
+                  (P(AXIS, None),) * 2, (P(AXIS, None),) * 2,
+                  P(AXIS, None), P(AXIS),
+                  P(None, None), P(None, None), P(None)),
         out_specs=P(AXIS),
         check_vma=False)  # see sharded_ed25519_verify
     return jax.jit(shmapped)
@@ -160,12 +161,22 @@ def sharded_verify_batch_secp256k1(mesh: Mesh, items, _cache={}):
     if n == 0:
         return np.zeros(0, dtype=bool)
     padded = items + [items[-1]] * (_pad_to_mesh_bucket(n, mesh) - n)
-    g_idx, q_bits, Qc, Qd, r_cands, precheck = \
+    *args, precheck = \
         wc_ops.prepare_batch_hybrid_wide(padded, wc_ops.HYBRID_G_WINDOW)
     key = ("secp256k1", id(mesh))
     if key not in _cache:
-        _cache[key] = sharded_ecdsa_verify_hybrid(mesh)
-    ok = np.asarray(_cache[key](g_idx, q_bits, Qc, Qd, r_cands))
+        # replicate the ~17MB constant-G table onto every mesh device ONCE,
+        # built from the HOST-side table: the single-device arrays baked
+        # into prepare's output would otherwise be re-broadcast on every
+        # call (their sharding mismatches the replicated in_spec)
+        from ..core.crypto.ecmath import SECP256K1
+        rep = jax.NamedSharding(mesh, P())
+        tabs = tuple(jax.device_put(t, rep) for t in
+                     wc_ops._g_window_table_wide(SECP256K1,
+                                                 wc_ops.HYBRID_G_WINDOW))
+        _cache[key] = (sharded_ecdsa_verify_hybrid(mesh), tabs)
+    fn, tabs = _cache[key]
+    ok = np.asarray(fn(*args[:-3], *tabs))
     return (ok & precheck)[:n]
 
 
