@@ -35,6 +35,11 @@ class FlowException(Exception):
     (reference FlowException — surfaces at the peer's receive)."""
 
 
+class FlowTimeoutException(FlowException):
+    """A Receive/SendAndReceive with ``timeout_s`` expired before the peer
+    replied (thrown at the yield site; the session stays usable)."""
+
+
 # ---------------------------------------------------------------------------
 # IO request types (FlowIORequest.kt analog)
 # ---------------------------------------------------------------------------
@@ -49,6 +54,10 @@ class Send:
 class Receive:
     party: Party
     expected_type: type = object
+    #: optional deadline (seconds on the node's clock): on expiry a
+    #: FlowTimeoutException is thrown at the yield site instead of parking
+    #: forever (ClockUtils fiber-aware deadline parity)
+    timeout_s: float | None = None
 
 
 @dataclass(frozen=True)
@@ -56,11 +65,38 @@ class SendAndReceive:
     party: Party
     payload: Any
     expected_type: type = object
+    timeout_s: float | None = None   # see Receive.timeout_s
 
 
 @dataclass(frozen=True)
 class WaitForLedgerCommit:
     tx_id: Any  # SecureHash
+
+
+@dataclass(frozen=True)
+class Sleep:
+    """Suspend the flow for ``seconds`` on the NODE's clock without blocking
+    the node thread (the reference's fiber-aware ClockUtils.awaitWithDeadline,
+    ClockUtils.kt): a timer — or a test clock advance — resumes it. A sleep
+    interrupted by a restart restarts in full on restore (the deadline is
+    re-armed relative to the restored clock)."""
+
+    seconds: float
+
+
+@dataclass(frozen=True)
+class Verify:
+    """Suspend until the node's TransactionVerifierService resolves the
+    verification of ``stx`` — the reference parks the flow fiber on the
+    verifier future (FlowStateMachineImpl.kt:379-393 via Services.kt:544-550),
+    so a Tpu- or OutOfProcess-backed node verifies OFF the node thread and
+    concurrently-suspended flows' signatures coalesce into shared device
+    batches. The flow resumes with None on success; a verification failure
+    is thrown at the yield site with its original type (preserved across
+    checkpoint replay via the typed error log entry)."""
+
+    stx: Any
+    check_sufficient_signatures: bool = True
 
 
 @dataclass(frozen=True)

@@ -18,7 +18,7 @@ from ..core.crypto.signatures import DigitalSignatureWithKey
 from ..core.serialization import register_type
 from ..core.transactions.signed import SignedTransaction
 from .api import (FlowException, FlowLogic, Receive, Send, SendAndReceive,
-                  initiating_flow)
+                  Verify, initiating_flow)
 
 MAX_RESOLVE_TRANSACTIONS = 5000  # ResolveTransactionsFlow.kt partial-tx cap
 
@@ -112,8 +112,7 @@ class NotaryServiceFlow(FlowLogic):
             # resolve dependencies from the requester, then fully verify
             yield from self.sub_flow(ResolveTransactionsFlow(
                 self.peer, stx=stx))
-            self.service.hub.verify_transaction(
-                stx, check_sufficient_signatures=False)
+            yield Verify(stx, check_sufficient_signatures=False)
         if not self.service.time_window_checker.is_valid(stx.tx.time_window):
             raise FlowException("Transaction time-window is outside tolerance")
         try:
@@ -284,7 +283,7 @@ class ResolveTransactionsFlow(FlowLogic):
         # topological order: dependencies before dependents
         order = _topological_order(fetched)
         for stx in order:
-            hub.verify_transaction(stx, check_sufficient_signatures=False)
+            yield Verify(stx, check_sufficient_signatures=False)
             hub.record_transactions(stx)
         return [stx.id for stx in order]
 
@@ -361,8 +360,7 @@ class NotifyTransactionHandler(FlowLogic):
         req = yield Receive(self.peer, NotifyTxRequest)
         stx = req.unwrap(lambda r: r.stx)
         yield from self.sub_flow(ResolveTransactionsFlow(self.peer, stx=stx))
-        self.service_hub.verify_transaction(
-            stx, check_sufficient_signatures=False)
+        yield Verify(stx, check_sufficient_signatures=False)
         self.service_hub.record_transactions(stx)
         yield Send(self.peer, b"ack")
         return None

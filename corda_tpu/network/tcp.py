@@ -34,8 +34,16 @@ from .messaging import (HandlerTable, Message, MessagingService,
 
 log = logging.getLogger(__name__)
 
-MAX_FRAME = 64 * 1024 * 1024
+#: Default max wire frame (message/attachment cap) — reference parity with
+#: Artemis' 10 MiB maxMessageSize (ArtemisMessagingServer.kt:95).
+MAX_FRAME = 10 * 1024 * 1024
 REDELIVERY_DELAY_S = 0.5
+
+
+class MessageSizeExceededError(ValueError):
+    """A frame exceeded the plane's max_frame cap. Raised synchronously to
+    LOCAL senders; an oversized INBOUND length header closes the connection
+    (the length cannot be trusted, so the stream is unrecoverable)."""
 MAX_SEND_ATTEMPTS = 10
 MAX_PENDING_FRAMES = 10_000       # per-peer outbound bound (backpressure)
 BACKPRESSURE_TIMEOUT_S = 30.0
@@ -51,10 +59,12 @@ class TcpMessagingService(MessagingService):
 
     def __init__(self, my_name: str, host: str, port: int,
                  resolve_address: Callable[[str], tuple | None],
-                 executor: SerialExecutor | None = None, tls=None):
+                 executor: SerialExecutor | None = None, tls=None,
+                 max_frame: int = MAX_FRAME):
         self._name = my_name
         self.host = host
         self.port = port
+        self.max_frame = max_frame
         self.tls = tls                      # network.tls.TlsConfig | None
         self.resolve_address = resolve_address
         self.executor = executor if executor is not None else SerialExecutor(
@@ -115,15 +125,25 @@ class TcpMessagingService(MessagingService):
             while True:
                 header = await reader.readexactly(4)
                 length = int.from_bytes(header, "big")
-                if length > MAX_FRAME:
-                    raise ValueError(f"frame too large: {length}")
+                if length > self.max_frame:
+                    # a hostile/buggy peer: one giant length header must not
+                    # make this node buffer unbounded bytes — drop the
+                    # connection (the Artemis max-message-size refusal)
+                    log.warning(
+                        "closing connection from %s: frame of %d bytes "
+                        "exceeds max_frame=%d",
+                        cert_cn or writer.get_extra_info("peername"),
+                        length, self.max_frame)
+                    raise MessageSizeExceededError(
+                        f"inbound frame too large: {length}")
                 body = await reader.readexactly(length)
                 topic, session_id, sender, payload = deserialize(body)
                 msg = Message(TopicSession(topic, session_id), payload,
                               sender=cert_cn if cert_cn is not None
                               else sender)
                 self.executor.execute(lambda m=msg: self._deliver(m))
-        except (asyncio.IncompleteReadError, ConnectionResetError):
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                MessageSizeExceededError):
             pass
         finally:
             self._inbound.discard(writer)
@@ -150,6 +170,12 @@ class TcpMessagingService(MessagingService):
              recipient: str) -> None:
         frame_body = serialize([topic_session.topic, topic_session.session_id,
                                 self._name, payload])
+        if len(frame_body) > self.max_frame:
+            # fail the producer synchronously with a typed error: a peer
+            # would just sever the connection on the oversized header
+            raise MessageSizeExceededError(
+                f"outbound frame of {len(frame_body)} bytes exceeds "
+                f"max_frame={self.max_frame} (10MiB Artemis parity cap)")
         frame = len(frame_body).to_bytes(4, "big") + frame_body
         fut = asyncio.run_coroutine_threadsafe(
             self._enqueue_send(recipient, frame), self._loop)

@@ -34,6 +34,9 @@ def main(argv=None) -> int:
     parser.add_argument("--network-map-address")
     parser.add_argument("--notary", choices=["simple", "validating"])
     parser.add_argument("--verifier-type", default="InMemory")
+    parser.add_argument("--mesh-devices", type=int, default=None,
+                        help="with --verifier-type Tpu: shard device "
+                             "batches over the first N local chips")
     parser.add_argument("--cordapp", action="append", default=None,
                         help="extra module to load as a cordapp (repeatable)")
     parser.add_argument("--quiet", action="store_true")
@@ -51,7 +54,8 @@ def main(argv=None) -> int:
             base_directory=args.base_dir,
             network_map_name=args.network_map_name,
             network_map_address=args.network_map_address,
-            notary=args.notary, verifier_type=args.verifier_type)
+            notary=args.notary, verifier_type=args.verifier_type,
+            mesh_devices=args.mesh_devices)
         if args.cordapp:
             config.cordapps = config.cordapps + args.cordapp
 

@@ -326,17 +326,19 @@ class ServiceHub:
     # -- verification (the TransactionVerifierService seam) ------------------
     def verify_transaction(self, stx,
                            check_sufficient_signatures: bool = True) -> None:
-        """Verify through the node's configured TransactionVerifierService
-        (Services.kt:544-550): with the TPU backend the signature EC math
-        rides the device batcher ACROSS concurrently-verifying flows; other
-        backends (or none) fall back to synchronous host verification.
-        This is the call flows make — the seam the reference routes through
-        `services.transactionVerifierService`."""
+        """BLOCKING verify through the node's configured
+        TransactionVerifierService (Services.kt:544-550) — for callers that
+        may block their thread (RPC handlers, tests, tools). Flows do NOT
+        call this: they `yield flows.api.Verify(stx)` and the SMM parks them
+        on the service future (the reference's fiber suspension,
+        FlowStateMachineImpl.kt:379-393), which is what lets Tpu/OutOfProcess
+        backends batch across concurrently-suspended flows."""
         svc = self.verifier_service
         # ONLY services whose futures resolve OFF the node thread may be
-        # awaited here: flows run on the single SerialExecutor, and e.g.
-        # the OutOfProcess service's responses arrive on that same executor
-        # — blocking on its future from a flow would deadlock the node
+        # blocked on here: e.g. the OutOfProcess service's responses arrive
+        # on the node's SerialExecutor — a caller ON that executor blocking
+        # for them would deadlock. (The flow path has no such restriction:
+        # Verify parks instead of blocking.)
         if svc is not None and hasattr(svc, "verify_signed") and \
                 getattr(svc, "resolves_off_node_thread", False):
             svc.verify_signed(

@@ -15,6 +15,8 @@ AbstractNode serverThread — and exactly MockNetwork's deterministic pumping).
 """
 from __future__ import annotations
 
+import queue
+import time as _time
 import uuid
 from concurrent.futures import Future
 from dataclasses import dataclass
@@ -22,7 +24,8 @@ from typing import Any
 
 from ..core.serialization import deserialize, register_type, serialize
 from ..flows.api import (ExecuteOnce, FlowException, FlowLogic, FlowSession,
-                         Receive, Send, SendAndReceive, UntrustworthyData,
+                         FlowTimeoutException, Receive, Send, SendAndReceive,
+                         Sleep, UntrustworthyData, Verify,
                          WaitForLedgerCommit, flow_name,
                          get_initiated_flow_factory)
 from ..network.messaging import TOPIC_P2P, TopicSession
@@ -131,6 +134,52 @@ class StateMachineManager:
         self.current_fsm: FlowStateMachine | None = None
         self.tx_mappings: list[tuple[str, Any]] = []   # (run_id, tx_id)
         self._mapping_observers: list = []
+        # Async-completion seam (the Verify suspension point): completions
+        # arriving on foreign threads (verifier pool, device batcher) are
+        # queued here and executed on the node thread via drain_external().
+        # scheduler_poke is installed by the runtime that owns the node
+        # thread — the real Node posts drain_external to its SerialExecutor,
+        # MockNetwork polls it from run_network().
+        self._external: "queue.Queue" = queue.Queue()
+        self._awaiting_external = 0
+        self.scheduler_poke = None
+        # Flow timers (Sleep + Receive timeouts — ClockUtils parity): the
+        # clock is injectable (seconds; tests install a TestClock) and
+        # timer_driver(delay_s, fire) is how a real-time runtime schedules
+        # the wake (the Node wires a threading.Timer that re-enters via the
+        # SerialExecutor); deterministic tests advance the clock and call
+        # wake_timers() instead. MONOTONIC by default: deadlines are
+        # relative, and a wall clock stepping backwards (NTP) would leave a
+        # due timer unfired forever.
+        self.clock = _time.monotonic
+        self.timer_driver = None
+        self._timers: list[tuple[float, str, Any]] = []  # (deadline, run_id, request)
+        self._next_wake: float | None = None   # soonest scheduled driver wake
+
+    @property
+    def awaiting_external(self) -> int:
+        """Flows parked on an off-node-thread future (e.g. Verify)."""
+        return self._awaiting_external
+
+    def _post_external(self, fn) -> None:
+        """Thread-safe: queue a completion for the node thread."""
+        self._external.put(fn)
+        poke = self.scheduler_poke
+        if poke is not None:
+            poke()
+
+    def drain_external(self) -> bool:
+        """Run queued async completions. MUST be called on the node thread
+        (the real Node's poke hook guarantees it; MockNetwork.run_network
+        polls from its single driving thread). Returns True if any ran."""
+        ran = False
+        while True:
+            try:
+                fn = self._external.get_nowait()
+            except queue.Empty:
+                return ran
+            ran = True
+            fn()
 
     def record_tx_mapping(self, run_id: str, tx_id) -> None:
         mapping = (run_id, tx_id)
@@ -265,6 +314,7 @@ class StateMachineManager:
             if action is _PARK:
                 fsm.parked_on = request
                 fsm.parked_group = fsm.current_group[0]
+                self._arm_timer(fsm, request)
                 self._checkpoint(fsm)
                 return
             kind, value, error = action
@@ -282,6 +332,12 @@ class StateMachineManager:
 
     def _resume(self, fsm: FlowStateMachine, value: Any = None,
                 error: Exception | None = None) -> None:
+        if self._timers:
+            # any timer armed for the park being resumed is dead: pruning
+            # here (a) stops a re-yielded identical request object from
+            # inheriting the previous park's deadline and (b) keeps the
+            # timer list from accumulating already-resumed flows' entries
+            self._timers = [t for t in self._timers if t[1] != fsm.run_id]
         fsm.parked_on = None
         self._advance(fsm, resume_value=value, resume_error=error)
 
@@ -304,7 +360,116 @@ class StateMachineManager:
             return _PARK
         if isinstance(request, ExecuteOnce):
             return self._log(fsm, ("value", request.producer()))
+        if isinstance(request, Verify):
+            return self._do_verify(fsm, request)
+        if isinstance(request, Sleep):
+            return _PARK        # woken only by its timer (see _arm_timer)
         raise TypeError(f"Flow yielded a non-request value: {request!r}")
+
+    # -- flow timers (Sleep / receive timeouts, ClockUtils parity) -----------
+    def _arm_timer(self, fsm: FlowStateMachine, request) -> None:
+        if isinstance(request, Sleep):
+            delay = max(0.0, float(request.seconds))
+        elif isinstance(request, (Receive, SendAndReceive)) and \
+                getattr(request, "timeout_s", None) is not None:
+            delay = max(0.0, float(request.timeout_s))
+        else:
+            return
+        deadline = self.clock() + delay
+        self._timers.append((deadline, fsm.run_id, request))
+        self._request_wake(deadline)
+
+    def _request_wake(self, deadline: float) -> None:
+        """Schedule ONE driver wake for the soonest deadline (not one OS
+        timer per armed request — N concurrent timeouts would mean N live
+        threads under Node's threading.Timer driver)."""
+        if self.timer_driver is None:
+            return
+        if self._next_wake is not None and self._next_wake <= deadline:
+            return
+        self._next_wake = deadline
+        self.timer_driver(max(0.0, deadline - self.clock()),
+                          self._on_timer_wake)
+
+    def _on_timer_wake(self) -> None:
+        self._next_wake = None
+        self.wake_timers()
+        nxt = self.next_timer_deadline()
+        if nxt is not None:
+            self._request_wake(nxt)
+
+    def wake_timers(self, now: float | None = None) -> int:
+        """Fire every due timer (node thread). Stale timers — their flow
+        already resumed, failed, or parked on a LATER request — are dropped
+        by the identity check against the live parked request."""
+        now = self.clock() if now is None else now
+        due = [t for t in self._timers if t[0] <= now]
+        if not due:
+            return 0
+        self._timers = [t for t in self._timers if t[0] > now]
+        fired = 0
+        for _, run_id, request in due:
+            fsm = self.flows.get(run_id)
+            if fsm is None or fsm.done or fsm.parked_on is not request:
+                continue
+            fired += 1
+            if isinstance(request, Sleep):
+                fsm.response_log.append(("value", None))
+                self._resume(fsm, value=None)
+            else:
+                err = FlowTimeoutException(
+                    f"Timed out after {request.timeout_s}s waiting for "
+                    f"{request.party.name}")
+                fsm.response_log.append(("error", _error_payload(err)))
+                self._resume(fsm, error=err)
+        return fired
+
+    def next_timer_deadline(self) -> float | None:
+        return min((t[0] for t in self._timers), default=None)
+
+    def _do_verify(self, fsm: FlowStateMachine, request: Verify):
+        """The Verify suspension point (FlowStateMachineImpl.kt:379-393): park
+        the flow on the configured TransactionVerifierService's future and
+        resume it on the node thread when the future resolves — so Tpu /
+        OutOfProcess backends verify off the node thread and N suspended
+        flows' signatures coalesce into shared device batches. Without an
+        async-capable service the verification runs synchronously here (the
+        no-service fallback of Services.kt)."""
+        svc = self.hub.verifier_service
+        if svc is None or not hasattr(svc, "verify_signed"):
+            try:
+                request.stx.verify(
+                    self.hub,
+                    check_sufficient_signatures=request.check_sufficient_signatures)
+            except Exception as e:
+                # same yield-site contract as the async path: the failure is
+                # thrown INTO the flow with its type preserved (a flow may
+                # catch SignatureException and recover), not routed to _fail
+                return self._log(fsm, ("error", _error_payload(e)))
+            return self._log(fsm, ("value", None))
+        fut = svc.verify_signed(
+            request.stx, self.hub,
+            check_sufficient_signatures=request.check_sufficient_signatures)
+        self._awaiting_external += 1
+        fut.add_done_callback(
+            lambda f: self._post_external(
+                lambda: self._on_verify_done(fsm, f)))
+        return _PARK
+
+    def _on_verify_done(self, fsm: FlowStateMachine, fut: Future) -> None:
+        """Node-thread continuation of a Verify park (via drain_external)."""
+        self._awaiting_external -= 1
+        if fsm.done or fsm.run_id not in self.flows:
+            return   # flow failed/completed meanwhile (e.g. session error)
+        err = fut.exception()
+        if err is None:
+            fsm.response_log.append(("value", None))
+            self._resume(fsm, value=None)
+        else:
+            # the log records the type too, so a flow that CAUGHT this
+            # error and continued replays identically after a restart
+            fsm.response_log.append(("error", _error_payload(err)))
+            self._resume(fsm, error=err)
 
     def _log(self, fsm: FlowStateMachine, entry):
         """Append to the response log and produce the resume action."""
@@ -319,7 +484,7 @@ class StateMachineManager:
         if kind == "commit":
             return (kind, self.hub.storage.get_transaction(value), None)
         if kind == "error":
-            return (kind, None, FlowException(value))
+            return (kind, None, _rebuild_error(value))
         raise AssertionError(entry)
 
     def _reexecute_parked(self, fsm: FlowStateMachine, request):
@@ -344,7 +509,7 @@ class StateMachineManager:
         if kind == "commit":
             return (kind, self.hub.storage.get_transaction(value), None)
         if kind == "error":
-            return (kind, None, FlowException(value))
+            return (kind, None, _rebuild_error(value))
         raise AssertionError(entry)
 
     def _try_receive(self, fsm: FlowStateMachine, party):
@@ -623,6 +788,33 @@ class StateMachineManager:
 
 
 _PARK = object()
+
+
+def _error_payload(exc: Exception):
+    """Checkpointable encoding of a flow-visible error that preserves the
+    TYPE across replay: flows legitimately catch specific exceptions
+    (FlowTimeoutException, SignatureException from Verify) and continue —
+    replaying them as bare FlowException would make a recovered flow
+    diverge after a restart. Plain FlowExceptions stay strings (legacy
+    log-entry format, still accepted by _rebuild_error)."""
+    if type(exc) is FlowException:
+        return str(exc)
+    return [f"{type(exc).__module__}:{type(exc).__qualname__}", str(exc)]
+
+
+def _rebuild_error(payload) -> Exception:
+    if isinstance(payload, str):
+        return FlowException(payload)
+    type_path, msg = payload
+    try:
+        import importlib
+        mod_name, qualname = type_path.split(":", 1)
+        obj = importlib.import_module(mod_name)
+        for attr in qualname.split("."):
+            obj = getattr(obj, attr)
+        return obj(msg)
+    except Exception:
+        return FlowException(msg)
 
 
 def _import_flow_class(name: str) -> type:

@@ -3,10 +3,10 @@ dummy contracts, mock services, the in-memory MockNetwork, ledger DSL and driver
 """
 from .dummy import DummyContract, DummyState, DUMMY_NOTARY_NAME
 from .expect import expect, parallel, repeat, run_expectations, sequence
-from .mocknetwork import MockNetwork, MockNode
+from .mocknetwork import MockNetwork, MockNode, TestClock
 from .services import MockAttachmentStorage, MockIdentityService, MockServices
 
 __all__ = ["DummyContract", "DummyState", "DUMMY_NOTARY_NAME",
            "expect", "parallel", "repeat", "run_expectations", "sequence",
            "MockAttachmentStorage", "MockIdentityService", "MockServices",
-           "MockNetwork", "MockNode"]
+           "MockNetwork", "MockNode", "TestClock"]

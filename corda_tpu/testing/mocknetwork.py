@@ -14,6 +14,21 @@ from ..node.services import NodeInfo, ServiceHub, ServiceInfo
 from ..node.statemachine import StateMachineManager
 
 
+class TestClock:
+    """Deterministic flow-timer clock (reference TestClock semantics): flows
+    sleeping or receiving-with-timeout wake only when a test advances it
+    (MockNetwork.advance_clock)."""
+
+    def __init__(self, start: float = 1_000_000.0):
+        self._now = start
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds
+
+
 class MockNode:
     def __init__(self, mock_net: "MockNetwork", name: str, key_pair: KeyPair,
                  advertised_services: tuple[ServiceInfo, ...] = (),
@@ -34,6 +49,7 @@ class MockNode:
             self.services.storage = storage
             self.services.vault.notify_all(storage.transactions)
         self.smm = StateMachineManager(self.services, checkpoint_storage)
+        self.smm.clock = mock_net.clock.now   # flow timers on the test clock
         self.services.smm = self.smm
         self.notary_service = None
         from ..flows.library import install_core_flows
@@ -86,6 +102,15 @@ class MockNetwork:
         self.bus = InMemoryMessagingNetwork()
         self.nodes: list[MockNode] = []
         self._counter = 0
+        self.clock = TestClock()
+
+    def advance_clock(self, seconds: float) -> int:
+        """Advance the shared test clock, fire every due flow timer, then
+        pump the network to quiescence. Returns fired timer count."""
+        self.clock.advance(seconds)
+        fired = sum(n.smm.wake_timers() for n in self.nodes)
+        self.run_network()
+        return fired
 
     def create_node(self, name: str | None = None,
                     advertised_services: tuple[ServiceInfo, ...] = (),
@@ -122,5 +147,37 @@ class MockNetwork:
         for node in self.nodes:
             node.start()
 
-    def run_network(self, rounds: int = -1, exclude=()) -> int:
-        return self.bus.run_network(rounds, exclude=exclude)
+    def run_network(self, rounds: int = -1, exclude=(),
+                    idle_timeout: float = 120.0) -> int:
+        """Pump until quiescent. Beyond message delivery, this also drains
+        each node's async verify completions (the Verify suspension point:
+        device/pool futures resolve on foreign threads and re-enter the flow
+        on this driving thread via smm.drain_external), waiting — bounded by
+        ``idle_timeout`` — while any flow is parked on such a future.
+        The default is generous because a parked flow's batch may be paying
+        a first jit-compile (tens of seconds on CPU, minutes through a cold
+        device tunnel) — that is progress the driving thread cannot see."""
+        total = self.bus.run_network(rounds, exclude=exclude)
+        if rounds != -1:
+            return total
+        import time as _time
+        excluded = set(exclude)
+        deadline = _time.monotonic() + idle_timeout
+        while True:
+            live = [n for n in self.nodes
+                    if str(n.info.address) not in excluded]
+            drained = False
+            for n in live:
+                drained |= n.smm.drain_external()
+            pumped = self.bus.run_network(-1, exclude=exclude)
+            total += pumped
+            if drained or pumped:
+                deadline = _time.monotonic() + idle_timeout
+                continue
+            if not any(n.smm.awaiting_external for n in live):
+                return total
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    "flows awaiting async verification made no progress "
+                    f"for {idle_timeout}s")
+            _time.sleep(0.002)

@@ -112,8 +112,35 @@ def run_load_test(test: LoadTest, ctx, iterations: int, seed: int = 0,
 # The standard scenarios (SelfIssueTest / CrossCashTest analogs)
 # ---------------------------------------------------------------------------
 
+class HangProcess(Disruption):
+    """HANG a real node process under load for a window — SIGSTOP the OS
+    process (it stays attached: sockets open, peers see silence, not EOF),
+    SIGCONT on restore. Disruption.kt:17-105's `hang` (the reference
+    suspends the remote JVM over SSH); this is the local-process edition."""
+
+    def __init__(self, pick: Callable[[Any], Any]):
+        import signal as _signal
+        self.pick = pick
+        self.name = "hang-process"
+        self._sigstop = _signal.SIGSTOP
+        self._sigcont = _signal.SIGCONT
+        self._victim = None
+
+    def apply(self, ctx) -> None:
+        import os as _os
+        self._victim = self.pick(ctx)
+        _os.kill(self._victim.process.pid, self._sigstop)
+
+    def restore(self, ctx) -> None:
+        import os as _os
+        if self._victim is not None:
+            _os.kill(self._victim.process.pid, self._sigcont)
+            self._victim = None
+
+
 def run_driver_cluster_load(dsl, parties, notary_party, iterations: int = 12,
                             seed: int = 0, kill_restart_at: int | None = None,
+                            hang_window: tuple[int, int] | None = None,
                             report_path: str | None = None) -> dict:
     """Drive a REAL subprocess cluster (testing.driver DriverDSL) with the
     self-issue/cross-cash mix over RPC, optionally hard-killing and
@@ -122,6 +149,9 @@ def run_driver_cluster_load(dsl, parties, notary_party, iterations: int = 12,
     edition the reference runs over SSH).
 
     ``parties``: mutable list of NodeHandle; index 1 is the kill victim.
+    ``hang_window``: (start_iter, end_iter) SIGSTOPs party 0 for those
+    iterations (Disruption.kt's hang-under-load); the cluster must make
+    progress around the hung member and complete once it resumes.
     Returns (and optionally writes) a BENCH-style JSON report with the
     measured flows/s and the conservation check result.
     """
@@ -131,32 +161,55 @@ def run_driver_cluster_load(dsl, parties, notary_party, iterations: int = 12,
     rng = random.Random(seed)
     issued_total = 0
     flows_done = 0
+    hang = HangProcess(lambda ctx: ctx["victim"]) \
+        if hang_window is not None else None
+    if hang is not None and not (0 <= hang_window[0] < hang_window[1]
+                                 < iterations):
+        raise ValueError(f"hang_window {hang_window} must fall inside "
+                         f"[0, {iterations})")
+    hang_active = False
     t0 = time.monotonic()
-    for it in range(iterations):
-        if kill_restart_at is not None and it == kill_restart_at:
-            victim = parties[1]
-            victim.process.kill()            # no goodbye, no flush
-            victim.process.wait(timeout=15)
-            parties[1] = dsl.restart_node(victim)
-        issuer = parties[rng.randrange(len(parties))]
-        quantity = rng.randint(1, 500) * 100
-        issuer.rpc.start_flow_and_wait(
-            "CashIssueFlow", Amount(quantity, USD), b"\x01",
-            issuer.rpc.node_identity().legal_identity, notary_party,
-            timeout_s=120)
-        issued_total += quantity
-        flows_done += 1
-        if len(parties) > 1 and rng.random() < 0.5:
-            a, b = rng.sample(range(len(parties)), 2)
-            balances = parties[a].rpc.get_cash_balances()
-            spendable = balances.get("USD", 0)
-            if spendable >= 100:
-                pay = min(spendable, rng.randint(1, 50) * 100)
-                parties[a].rpc.start_flow_and_wait(
-                    "CashPaymentFlow", Amount(pay, USD),
-                    parties[b].rpc.node_identity().legal_identity,
-                    timeout_s=120)
-                flows_done += 1
+    try:
+        for it in range(iterations):
+            if hang is not None:
+                if it == hang_window[0]:
+                    hang.apply({"victim": parties[0]})
+                    hang_active = True
+                if it == hang_window[1]:
+                    hang.restore(None)
+                    hang_active = False
+            if kill_restart_at is not None and it == kill_restart_at:
+                victim = parties[1]
+                victim.process.kill()            # no goodbye, no flush
+                victim.process.wait(timeout=15)
+                parties[1] = dsl.restart_node(victim)
+            # while a member hangs, load routes around it (the reference's
+            # disruption runs expect the healthy members to keep serving)
+            live = parties[1:] if hang_active and len(parties) > 1 else parties
+            issuer = live[rng.randrange(len(live))]
+            quantity = rng.randint(1, 500) * 100
+            issuer.rpc.start_flow_and_wait(
+                "CashIssueFlow", Amount(quantity, USD), b"\x01",
+                issuer.rpc.node_identity().legal_identity, notary_party,
+                timeout_s=120)
+            issued_total += quantity
+            flows_done += 1
+            if len(live) > 1 and rng.random() < 0.5:
+                a, b = rng.sample(range(len(live)), 2)
+                balances = live[a].rpc.get_cash_balances()
+                spendable = balances.get("USD", 0)
+                if spendable >= 100:
+                    pay = min(spendable, rng.randint(1, 50) * 100)
+                    live[a].rpc.start_flow_and_wait(
+                        "CashPaymentFlow", Amount(pay, USD),
+                        live[b].rpc.node_identity().legal_identity,
+                        timeout_s=120)
+                    flows_done += 1
+    finally:
+        # an RPC failure mid-window must never leave the victim SIGSTOPped:
+        # the driver teardown would block forever on the frozen process
+        if hang is not None and hang._victim is not None:
+            hang.restore(None)
     elapsed = time.monotonic() - t0
     held_total = sum(h.rpc.get_cash_balances().get("USD", 0)
                      for h in parties)

@@ -47,6 +47,10 @@ def main(argv=None) -> int:
     parser.add_argument("--host-crossover", type=int, default=None,
                         help="batches below this run on host (default: "
                              "the batcher's measured crossover)")
+    parser.add_argument("--mesh-devices", type=int, default=None,
+                        help="shard device batches over the first N local "
+                             "chips (jax.sharding.Mesh; Verifier.kt's "
+                             "scale-out seam, SPMD instead of N processes)")
     parser.add_argument("--stats-file",
                         help="write batcher metrics JSON here on shutdown")
     parser.add_argument("--cordapp", action="append", default=None,
@@ -82,6 +86,9 @@ def main(argv=None) -> int:
     batcher_kwargs = {"use_device": not args.no_device}
     if args.host_crossover is not None:
         batcher_kwargs["host_crossover"] = args.host_crossover
+    if args.mesh_devices is not None:
+        from ..parallel import make_mesh
+        batcher_kwargs["mesh"] = make_mesh(args.mesh_devices)
     batcher = SignatureBatcher(**batcher_kwargs)
     worker = VerifierWorker(messaging, args.queue_address, batcher=batcher,
                             use_device=not args.no_device,

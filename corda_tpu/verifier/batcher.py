@@ -180,12 +180,25 @@ class SignatureBatcher:
                     return
                 # linger only when a device-scale batch is building: below
                 # the host crossover these items go to the host path anyway,
-                # so waiting would add pure latency (the p50@1 case)
+                # so waiting would add pure latency (the p50@1 case).
+                # The linger is a WINDOW, not a single wait: each arriving
+                # submit notifies the condition, and returning on the first
+                # notification would fragment a burst of N submits into many
+                # tiny batches — keep collecting until the deadline passes
+                # or a full batch builds.
                 depth = max((len(q) for q in self._queues.values()),
                             default=0)
                 if (self.host_crossover <= depth < self.max_batch
                         and not self._closed and any(self._queues.values())):
-                    self._lock.wait(timeout=self.max_latency_s)
+                    import time as _time
+                    deadline = _time.monotonic() + self.max_latency_s
+                    while not self._closed and depth < self.max_batch:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._lock.wait(timeout=remaining)
+                        depth = max((len(q) for q in self._queues.values()),
+                                    default=0)
                 drained = {name: q[: self.max_batch]
                            for name, q in self._queues.items() if q}
                 for name, items in drained.items():

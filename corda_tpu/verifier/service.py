@@ -33,6 +33,16 @@ class TransactionVerifierService:
     def verify(self, ltx) -> Future:
         return self._submit_instrumented(ltx.verify)
 
+    def verify_signed(self, stx, services,
+                      check_sufficient_signatures: bool = True) -> Future:
+        """Async full verify of a SignedTransaction on the service's pool —
+        the future every backend offers the SMM's Verify suspension point
+        (flows park on it instead of blocking the node thread). Subclasses
+        accelerate it (Tpu: device-batched signatures; OutOfProcess: worker
+        fan-out); this base version runs `stx.verify` host-side."""
+        return self._submit_instrumented(lambda: stx.verify(
+            services, check_sufficient_signatures=check_sufficient_signatures))
+
     def _submit_instrumented(self, work_fn) -> Future:
         self.metrics.counter("Verification.InFlight").inc()
 
@@ -121,7 +131,15 @@ class TpuTransactionVerifierService(TransactionVerifierService):
 def make_verifier_service(verifier_type: str = "InMemory", **kwargs
                           ) -> TransactionVerifierService:
     """The VerifierType config seam (NodeConfiguration.kt:91-94):
-    "InMemory" | "Tpu" ("OutOfProcess" arrives with the messaging layer)."""
+    "InMemory" | "Tpu" ("OutOfProcess" arrives with the messaging layer).
+
+    NOTE on the Tpu backend: only ``verify_signed(stx, ...)`` pays off on
+    device — the reference-shaped ``verify(ltx)`` SPI verifies contract and
+    platform rules only (an ltx's signatures are already checked by the time
+    it exists), so callers holding a SignedTransaction should use
+    ``verify_signed``. The node's flow path does (the SMM's Verify
+    suspension point routes through verify_signed; locked by
+    tests/test_verify_suspension.py's device-batch assertion)."""
     if verifier_type == "InMemory":
         return InMemoryTransactionVerifierService(**kwargs)
     if verifier_type == "Tpu":

@@ -1,0 +1,113 @@
+"""Flow timers (VERDICT r3 #7): Sleep + receive-with-timeout on the node's
+injectable clock — the reference's fiber-aware ClockUtils.awaitWithDeadline
+(node/utilities/ClockUtils.kt) semantics: a sleeping flow never blocks the
+node thread, a TestClock advance wakes it deterministically, and a timed-out
+receive throws FlowTimeoutException at the yield site.
+"""
+from corda_tpu.flows.api import (FlowLogic, FlowTimeoutException, Receive,
+                                 Send, Sleep, initiating_flow)
+from corda_tpu.testing import MockNetwork
+
+
+class SleepingFlow(FlowLogic):
+    def __init__(self, seconds):
+        self.seconds = seconds
+
+    def call(self):
+        yield Sleep(self.seconds)
+        return "woke"
+
+
+@initiating_flow
+class AskWithTimeoutFlow(FlowLogic):
+    def __init__(self, peer, timeout_s):
+        self.peer = peer
+        self.timeout_s = timeout_s
+
+    def call(self):
+        yield Send(self.peer, "question")
+        try:
+            reply = yield Receive(self.peer, str, timeout_s=self.timeout_s)
+        except FlowTimeoutException:
+            return "timed-out"
+        return reply.unwrap(lambda d: d)
+
+
+def make_silent_responder():
+    """Responder that reads the question and never answers."""
+    class SilentResponder(FlowLogic):
+        def __init__(self, peer):
+            self.peer = peer
+
+        def call(self):
+            yield Receive(self.peer, str)
+            yield Receive(self.peer, str)    # parks forever
+    return SilentResponder
+
+
+def make_prompt_responder():
+    class PromptResponder(FlowLogic):
+        def __init__(self, peer):
+            self.peer = peer
+
+        def call(self):
+            yield Receive(self.peer, str)
+            yield Send(self.peer, "answer")
+    return PromptResponder
+
+
+def two_nodes():
+    network = MockNetwork()
+    a = network.create_node("O=A, L=London, C=GB")
+    b = network.create_node("O=B, L=Paris, C=FR")
+    network.start_nodes()
+    return network, a, b
+
+
+def test_sleep_wakes_on_test_clock_only():
+    network, a, _ = two_nodes()
+    fsm = a.start_flow(SleepingFlow(10.0))
+    network.run_network()
+    assert not fsm.result_future.done()      # pumping alone must not wake it
+    network.advance_clock(5.0)
+    assert not fsm.result_future.done()
+    assert network.advance_clock(5.1) == 1
+    assert fsm.result_future.result(timeout=5) == "woke"
+
+
+def test_receive_timeout_throws_at_yield_site():
+    network, a, b = two_nodes()
+    from corda_tpu.flows.api import flow_name
+    b.smm.register_flow_factory(flow_name(AskWithTimeoutFlow),
+                                make_silent_responder())
+    fsm = a.start_flow(AskWithTimeoutFlow(b.party, timeout_s=20.0))
+    network.run_network()
+    assert not fsm.result_future.done()
+    network.advance_clock(21.0)
+    assert fsm.result_future.result(timeout=5) == "timed-out"
+
+
+def test_reply_before_deadline_cancels_timer():
+    network, a, b = two_nodes()
+    from corda_tpu.flows.api import flow_name
+    b.smm.register_flow_factory(flow_name(AskWithTimeoutFlow),
+                                make_prompt_responder())
+    fsm = a.start_flow(AskWithTimeoutFlow(b.party, timeout_s=20.0))
+    network.run_network()
+    assert fsm.result_future.result(timeout=5) == "answer"
+    # the stale timer must not corrupt anything when it fires later
+    assert network.advance_clock(30.0) == 0
+
+
+def test_sleep_survives_restart():
+    """Mid-sleep restart: the restored flow re-parks on its Sleep and the
+    deadline re-arms in full on the restored clock (documented semantics)."""
+    network, a, _ = two_nodes()
+    fsm = a.start_flow(SleepingFlow(10.0))
+    network.run_network()
+    assert not fsm.result_future.done()
+    a2 = a.restart()
+    a2.start()
+    restored = list(a2.smm.flows.values())[0]
+    network.advance_clock(10.1)
+    assert restored.result_future.result(timeout=5) == "woke"
