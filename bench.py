@@ -113,15 +113,29 @@ def service_metrics(items):
             assert batcher.submit(key, der, msg).result(timeout=60)
             latencies.append(time.perf_counter() - t0)
         p50_ms = sorted(latencies)[len(latencies) // 2] * 1000.0
+        # mid-size-batch latency (VERDICT r3 weak #5): the band between the
+        # host crossover (192) and dispatch-floor amortization (~8k) pays
+        # the linger window plus the fixed device dispatch — report it so
+        # the worst-case latency region is visible, not just batch=1
+        # warm the 1k bucket first: its kernel compile must not pollute the
+        # latency sample (nor trip the sample timeout on a cold cache)
+        assert all(batcher.submit_group(triples[:1024]).result(timeout=900))
+        mid = []
+        for _ in range(9):
+            t0 = time.perf_counter()
+            assert all(batcher.submit_group(triples[:1024]).result(
+                timeout=120))
+            mid.append(time.perf_counter() - t0)
+        p50_1k_ms = sorted(mid)[len(mid) // 2] * 1000.0
     finally:
         batcher.close()
-    return service_rate, p50_ms
+    return service_rate, p50_ms, p50_1k_ms
 
 
 def main() -> None:
     items = make_items(BATCH)
     dev = device_rate(items)
-    service_rate, p50_ms = service_metrics(items)
+    service_rate, p50_ms, p50_1k_ms = service_metrics(items)
     host = host_baseline_rate(items[: min(128, BATCH)])
     print(json.dumps({
         "metric": "ecdsa_secp256k1_verifies_per_sec_per_chip",
@@ -130,6 +144,7 @@ def main() -> None:
         "vs_baseline": round(dev / host, 3),
         "service_path_verifies_per_sec": round(service_rate, 1),
         "tx_verify_p50_ms_batch1": round(p50_ms, 3),
+        "tx_verify_p50_ms_batch1k": round(p50_1k_ms, 3),
         "host_baseline_verifies_per_sec": round(host, 1),
     }))
 

@@ -205,6 +205,51 @@ class CordaRPCClient:
             time.sleep(poll_s)
         raise RPCException(f"flow {run_id} did not finish in {timeout_s}s")
 
+    def wait_until_registered_with_network_map(self,
+                                               timeout_s: float = 60.0):
+        """Genuine FUTURE semantics (CordaRPCOps.kt:275 returns a
+        ListenableFuture): completes when the node reports itself
+        registered, with the initial probe short-circuiting an
+        already-registered node. Network-map pushes ACCELERATE a dedicated
+        waiter thread, which does all the re-probing itself: an RPC from
+        inside the feed callback would deadlock (callbacks run on the one
+        messaging thread that also delivers RPC responses), and the single
+        setter thread means no missed-event or set_result races."""
+        from concurrent.futures import Future as _Future
+        fut: _Future = _Future()
+        if self.call("wait_until_registered_with_network_map"):
+            fut.set_result(True)
+            return fut
+        feed = self.network_map_feed()
+        kick = threading.Event()
+        feed.subscribe(lambda _event: kick.set())
+
+        def waiter():
+            deadline = time.monotonic() + timeout_s
+            try:
+                while True:
+                    if self.call("wait_until_registered_with_network_map"):
+                        fut.set_result(True)
+                        return
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        fut.set_exception(RPCException(
+                            "not registered with the network map in "
+                            f"{timeout_s}s"))
+                        return
+                    # push-accelerated, 1s-bounded poll: a change pushed
+                    # BEFORE the subscription landed is still caught
+                    kick.wait(timeout=min(remaining, 1.0))
+                    kick.clear()
+            finally:
+                try:
+                    feed.close()
+                except Exception:
+                    pass
+        threading.Thread(target=waiter, daemon=True,
+                         name="rpc-registration-wait").start()
+        return fut
+
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)

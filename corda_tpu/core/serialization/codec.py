@@ -33,6 +33,7 @@ _EXT_SET = 3
 _EXT_BIGINT = 4
 _EXT_ENUM = 5
 _EXT_INSTANT = 6  # UTC datetime as epoch-microseconds (big-endian i64)
+_EXT_OBJ_SCHEMA = 7  # [name, [field names], fields] — carpentable object
 
 _I64_MIN, _I64_MAX = -(2**63), 2**63 - 1
 
@@ -60,19 +61,42 @@ def exact_epoch_micros(t: datetime.datetime) -> int:
 _REGISTRY: dict[str, tuple[type, Callable, Callable]] = {}
 _BY_CLASS: dict[type, str] = {}
 _ENUM_REGISTRY: dict[str, type] = {}
+# schema-carrying types (name -> field names); their wire form embeds the
+# field names so receivers WITHOUT the class can still materialize them
+_SCHEMA_NAMES: dict[str, list[str]] = {}
+# receiver-side synthesized classes for unknown schema'd names
+# (ClassCarpenter.kt:30-447 analog) — deliberately NOT in _REGISTRY: the
+# trusted whitelist stays authoritative, and a later real registration of
+# the same name simply wins for subsequent decodes
+_CARPENTED: dict[str, tuple[type, list[str]]] = {}
+_CARPENTED_BY_CLASS: dict[type, str] = {}
 
 
 def register_type(name: str, cls: type,
                   to_fields: Callable[[Any], list] | None = None,
-                  from_fields: Callable[[list], Any] | None = None) -> None:
+                  from_fields: Callable[[list], Any] | None = None,
+                  carry_schema: bool = False) -> None:
     """Register a type for serialization. Defaults handle dataclasses (fields in
-    declaration order — deterministic)."""
+    declaration order — deterministic).
+
+    ``carry_schema=True`` writes the field NAMES onto the wire so a receiver
+    that does not know the class can carpent a property-bag stand-in
+    (see :func:`carpented_class`) — use it for types expected to travel to
+    nodes without the defining CorDapp module."""
     if name in _REGISTRY and _REGISTRY[name][0] is not cls:
         raise SerializationError(f"Serialization name collision: {name!r}")
-    if to_fields is None or from_fields is None:
+    if carry_schema and (to_fields is not None or from_fields is not None):
+        # the carried names are the dataclass's declared fields; a custom
+        # codec could reorder/transform values, silently binding receivers'
+        # carpented attributes to the wrong values
+        raise SerializationError(
+            "carry_schema requires the default dataclass field codec")
+    if to_fields is None or from_fields is None or carry_schema:
         if not dataclasses.is_dataclass(cls):
             raise SerializationError(
-                f"{cls!r} is not a dataclass; provide to_fields/from_fields")
+                f"{cls!r} is not a dataclass; provide to_fields/from_fields"
+                + (" (carry_schema needs dataclass field names)"
+                   if carry_schema else ""))
         field_names = [f.name for f in dataclasses.fields(cls)]
         to_fields = to_fields or (lambda obj, _fn=field_names:
                                   [getattr(obj, n) for n in _fn])
@@ -81,8 +105,60 @@ def register_type(name: str, cls: type,
         from_fields = from_fields or (
             lambda fields, _c=cls: _c(*[tuple(f) if isinstance(f, list) else f
                                         for f in fields]))
+        if carry_schema:
+            _SCHEMA_NAMES[name] = field_names
     _REGISTRY[name] = (cls, to_fields, from_fields)
     _BY_CLASS[cls] = name
+
+
+#: Cap on distinct carpented names: classes are heavyweight and live
+#: instances pin them, so eviction would fork a name across two classes —
+#: refuse instead (no legitimate peer set ships thousands of state types).
+_CARPENTED_MAX = 4096
+
+
+def carpented_class(name: str, field_names: list[str]) -> type:
+    """Synthesize (once per name+schema) a frozen-dataclass property bag for
+    a schema'd wire object whose real class is absent — the runtime class
+    synthesis of the reference's ClassCarpenter, minus bytecode: the bag is
+    inert data (no methods), so the deserialization whitelist's gadget
+    protection is preserved. Carpented instances re-serialize bit-exactly
+    under the original name with the carried schema (round-trip safe).
+    Every hostile-input failure mode is a SerializationError."""
+    import keyword
+
+    entry = _CARPENTED.get(name)
+    if entry is not None:
+        cls, known = entry
+        if known != list(field_names):
+            raise SerializationError(
+                f"Conflicting carpented schemas for {name!r}: "
+                f"{known} vs {list(field_names)}")
+        return cls
+    if not isinstance(name, str) or not name:
+        raise SerializationError(f"Bad carpented type name {name!r}")
+    if len(_CARPENTED) >= _CARPENTED_MAX:
+        raise SerializationError(
+            f"Carpented-type limit ({_CARPENTED_MAX}) reached; "
+            f"refusing to synthesize {name!r}")
+    seen = set()
+    for fn in field_names:
+        if (not isinstance(fn, str) or not fn.isidentifier()
+                or fn.startswith("__") or keyword.iskeyword(fn)
+                or fn in seen):
+            raise SerializationError(f"Bad carpented field name {fn!r}")
+        seen.add(fn)
+    try:
+        cls = dataclasses.make_dataclass(
+            name.rsplit(".", 1)[-1] or "Carpented",
+            [(fn, Any) for fn in field_names], frozen=True, eq=True)
+    except (TypeError, ValueError) as e:
+        raise SerializationError(
+            f"Cannot carpent {name!r}: {e}") from e
+    cls.__corda_carpented__ = name
+    _CARPENTED[name] = (cls, list(field_names))
+    _CARPENTED_BY_CLASS[cls] = name
+    return cls
 
 
 def serializable(name: str | None = None,
@@ -147,11 +223,20 @@ def to_wire(obj: Any) -> Any:
         return msgpack.ExtType(_EXT_ENUM, _packb([ename, obj.name]))
     name = _BY_CLASS.get(type(obj))
     if name is None:
+        cname = _CARPENTED_BY_CLASS.get(type(obj))
+        if cname is not None:      # carpented bag: round-trips bit-exactly
+            _, field_names = _CARPENTED[cname]
+            fields = [to_wire(getattr(obj, fn)) for fn in field_names]
+            return msgpack.ExtType(_EXT_OBJ_SCHEMA,
+                                   _packb([cname, field_names, fields]))
         raise SerializationError(
             f"Type {type(obj).__module__}.{type(obj).__qualname__} is not registered "
             f"for serialization (whitelist violation)")
     _, to_fields, _ = _REGISTRY[name]
     fields = [to_wire(f) for f in to_fields(obj)]
+    schema = _SCHEMA_NAMES.get(name)
+    if schema is not None:
+        return msgpack.ExtType(_EXT_OBJ_SCHEMA, _packb([name, schema, fields]))
     return msgpack.ExtType(_EXT_OBJ, _packb([name, fields]))
 
 
@@ -193,6 +278,18 @@ def from_wire(wire: Any) -> Any:
                 raise SerializationError(f"Type {name!r} is not whitelisted")
             _, _, from_fields = entry
             return from_fields([from_wire(f) for f in fields])
+        if code == _EXT_OBJ_SCHEMA:
+            name, field_names, fields = _unpackb(data)
+            entry = _REGISTRY.get(name)
+            if entry is not None:       # the real class is known: it wins
+                _, _, from_fields = entry
+                return from_fields([from_wire(f) for f in fields])
+            if len(field_names) != len(fields):
+                raise SerializationError(
+                    f"Schema'd object {name!r}: {len(field_names)} names "
+                    f"vs {len(fields)} fields")
+            cls = carpented_class(name, field_names)
+            return cls(*[_freeze(from_wire(f)) for f in fields])
         raise SerializationError(f"Unknown ext code {code}")
     if isinstance(wire, (list, tuple)):
         return [from_wire(x) for x in wire]

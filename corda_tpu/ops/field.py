@@ -131,6 +131,7 @@ def _fold_once(v, bounds, c_limbs):
     nh = len(hib)
     nb = _fold_bounds(bounds, c_limbs)
     assert nb is not None, "u64 column overflow"
+    hi = _mul_operand(hi, hib)
     acc_w = max(NLIMB, nh + len(c_limbs))
     acc = jnp.zeros(v.shape[:-1] + (acc_w,), dtype=jnp.uint64)
     acc = acc.at[..., :NLIMB].add(lo)
@@ -234,6 +235,16 @@ def canon(a, p: int):
 # All take and return contract elements (see module docstring).
 # ---------------------------------------------------------------------------
 
+def _mul_operand(a, bounds):
+    """Route a multiplicand whose exact bounds fit u32 through a
+    u32→u64 convert: the value is unchanged (bounds prove the truncation
+    is lossless) but the convert ANNOTATES the range, letting the TPU
+    backend lower the u64 products to half-width multiplies."""
+    if max(bounds) < (1 << 32):
+        return a.astype(jnp.uint32).astype(jnp.uint64)
+    return a
+
+
 def raw_mul_bounded(a, b, a_bounds=None, b_bounds=None):
     """Full product with exact column bounds: bounded × bounded → wide.
     Input bounds default to the contract; callers passing *relaxed* operands
@@ -246,6 +257,8 @@ def raw_mul_bounded(a, b, a_bounds=None, b_bounds=None):
     new hardware."""
     a_bounds = _CONTRACT if a_bounds is None else a_bounds
     b_bounds = _CONTRACT if b_bounds is None else b_bounds
+    a = _mul_operand(a, a_bounds)
+    b = _mul_operand(b, b_bounds)
     cols = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
                      + (2 * NLIMB - 1,), dtype=jnp.uint64)
     for i in range(NLIMB):
@@ -327,6 +340,8 @@ def mul_cols(ar, br):
     normalize. Accepts plain arrays (contract bounds) or (v, bounds)."""
     a, ab = ar if isinstance(ar, tuple) else rel(ar)
     b, bb = br if isinstance(br, tuple) else rel(br)
+    a = _mul_operand(a, ab)
+    b = _mul_operand(b, bb)
     na, nbw = len(ab), len(bb)
     cols = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
                      + (na + nbw - 1,), dtype=jnp.uint64)
@@ -346,7 +361,7 @@ def scale_rel(a, k: int, bounds=None):
     b = _CONTRACT if bounds is None else bounds
     out = [x * k for x in b]
     assert max(out) < (1 << 63)
-    return (a * jnp.uint64(k), out)
+    return (_mul_operand(a, b) * jnp.uint64(k), out)
 
 
 def scale_cols(cr, k: int):
@@ -355,7 +370,7 @@ def scale_cols(cr, k: int):
     v, nb = cr
     out = [b * k for b in nb]
     assert max(out) < (1 << 63), "u64 column overflow in scale_cols"
-    return (v * jnp.uint64(k), out)
+    return (_mul_operand(v, nb) * jnp.uint64(k), out)
 
 
 _DOM_OFFSETS: dict = {}
@@ -428,7 +443,8 @@ def raw_sqr_bounded(a, bounds):
     general products; `dbl`'s Y² / Z² and Fermat's square chain are the
     beneficiaries). Bounds are identical to the general product's."""
     n = len(bounds)
-    a2 = a * jnp.uint64(2)
+    a = _mul_operand(a, bounds)
+    a2 = _mul_operand(a * jnp.uint64(2), [b * 2 for b in bounds])
     cols = jnp.zeros(a.shape[:-1] + (2 * n - 1,), dtype=jnp.uint64)
     # row i covers columns [2i, i+n): the diagonal a_i² then doubled cross
     # terms a_i·2a_j (j > i) — CONTIGUOUS slice updates (a strided
@@ -526,7 +542,7 @@ def mul_const(a, c: int, p: int):
     if c == 0:
         return jnp.zeros_like(a)
     nb = [b * c for b in _CONTRACT]
-    return _normalize(a * jnp.uint64(c), nb, p)[0]
+    return _normalize(_mul_operand(a, _CONTRACT) * jnp.uint64(c), nb, p)[0]
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ the affine check X/Z == r_cand is done projectively as X == r_cand·Z.
 """
 from __future__ import annotations
 
+import functools
 import hashlib
 
 import jax
@@ -98,6 +99,9 @@ def _add_k1(Pt, Qt, p: int, b3: int):
     t5 = F.norm(F.col_acc(p, plus=[F.mul_cols(F.rel_add(Y1, Z1),
                                               F.rel_add(Y2, Z2))],
                           minus=[c1, c2]), p)
+    # NOTE: bt2 as scale_rel (skipping this walk) was measured a WASH-to-
+    # regression: the relaxed Xm/Zm bounds push an extra pass into each of
+    # the three downstream norms — the carry-conservation law again
     bt2 = F.mul_const(t2, b3, p)
     Xm = F.rel_sub(t1, bt2, p)       # t1 - b3·t2, relaxed (no normalize)
     Zm = F.rel_add(t1, bt2)          # t1 + b3·t2, relaxed
@@ -132,7 +136,7 @@ def _madd_k1(Pt, Qa, p: int, b3: int):
     t4b3 = F.norm(F.scale_cols(
         F.col_acc(p, plus=[F.mul_cols(Z1, X2), F.rel(X1)]), b3), p)
     t5 = F.norm(F.col_acc(p, plus=[F.mul_cols(Z1, Y2), F.rel(Y1)]), p)
-    bt2 = F.mul_const(Z1, b3, p)
+    bt2 = F.mul_const(Z1, b3, p)     # walked: see _add_k1's bt2 note
     Xm = F.rel_sub(t1, bt2, p)       # t1 - b3·t2, relaxed
     Zm = F.rel_add(t1, bt2)          # t1 + b3·t2, relaxed
     Y3 = F.norm(F.col_acc(p, plus=[F.mul_cols(Xm, Zm),
@@ -336,11 +340,15 @@ def _accept(X, Z, r_cands, p):
 def _accept_rn(X, Z, r, rn_ok, p: int, n: int):
     """Like :func:`_accept`, but the second x-candidate (r + n, valid only
     when it stays below p) is DERIVED on device from r and a 1-bit flag —
-    half the candidate wire bytes of shipping both limb arrays."""
+    half the candidate wire bytes of shipping both limb arrays. X is
+    canonicalised ONCE and compared against both candidates (F.eq would
+    re-canonicalise it per comparison; canon's serial sweeps are the
+    epilogue's dominant cost)."""
     nonzero = ~F.is_zero(Z, p)
     r1 = F.add(r, jnp.broadcast_to(jnp.asarray(F.to_limbs(n)), r.shape), p)
-    ok_r = (F.eq(X, F.mul(r, Z, p), p)
-            | (rn_ok & F.eq(X, F.mul(r1, Z, p), p)))
+    cx = F.canon(X, p)
+    ok_r = (jnp.all(cx == F.canon(F.mul(r, Z, p), p), axis=-1)
+            | (rn_ok & jnp.all(cx == F.canon(F.mul(r1, Z, p), p), axis=-1)))
     return nonzero & ok_r
 
 
@@ -381,6 +389,16 @@ def _batch_modinv(values, n: int):
     return out
 
 
+@functools.lru_cache(maxsize=65536)
+def _is_on_curve_memo(curve_name: str, pub) -> bool:
+    """Memoized on-curve check (same per-signer caching pattern as
+    keys.py's decompress LRU): a node verifies the same signers'
+    transactions over and over, and the 3-modmul curve test per ITEM was a
+    measurable slice of host prep — the service path is host-CPU-bound at
+    32k batches."""
+    return CURVES[curve_name].is_on_curve(pub)
+
+
 def _precheck_and_scalars(curve: WeierstrassCurve, items):
     """Shared ECDSA acceptance policy for both kernel preps: structural checks
     (r/s ranges incl. low-s rule, on-curve key), e/w/u1/u2 derivation, the
@@ -391,7 +409,7 @@ def _precheck_and_scalars(curve: WeierstrassCurve, items):
     pubs, rs, es, ss = [], [], [], []
     for i, (pub, msg, r, s) in enumerate(items):
         ok = (1 <= r < curve.n and 1 <= s <= curve.n // 2
-              and pub is not None and curve.is_on_curve(pub))
+              and pub is not None and _is_on_curve_memo(curve.name, pub))
         if ok:
             es.append(_bits2int(hashlib.sha256(msg).digest(), curve.n)
                       % curve.n)

@@ -127,10 +127,11 @@ def test_wc_add_matches_host(curve):
     [(ecmath.SECP256K1, "plain"),
      (ecmath.SECP256K1, "glv"),      # endomorphism all-select ladder
      (ecmath.SECP256K1, "hybrid"),   # endomorphism + constant-G gather table
-     # r1's 224-bit Solinas fold constant makes its kernel a multi-minute XLA
-     # compile; the shared kernel code is covered by k1, and r1 point math by
-     # test_wc_add_matches_host.
-     pytest.param(ecmath.SECP256R1, "plain", marks=pytest.mark.slow)],
+     # r1 runs in the DEFAULT tier (VERDICT r3 #5): its 224-bit Solinas fold
+     # constant makes the cold compile ~4min on CPU, but the persistent
+     # .jax_cache (shared by CI/driver runs on this workspace) makes warm
+     # runs seconds — an untested-by-default kernel is an unshipped kernel.
+     (ecmath.SECP256R1, "plain")],
     ids=lambda v: v if isinstance(v, str) else v.name)
 def test_ecdsa_verify_batch(curve, mode):
     items, want = [], []

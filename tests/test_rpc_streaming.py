@@ -202,3 +202,17 @@ def test_remote_disconnect_cleans_up_feeds(live_node):
             time.sleep(0.5)
     finally:
         driver.close()
+
+
+def test_wait_until_registered_future(live_node):
+    """CordaRPCOps.kt:275 parity: the client-side registration wait is a
+    genuine Future (push-driven off the network-map feed), not a poll
+    loop the caller has to write."""
+    from corda_tpu.client.rpc import CordaRPCClient
+
+    client = CordaRPCClient("127.0.0.1", live_node.messaging.port)
+    try:
+        fut = client.wait_until_registered_with_network_map(timeout_s=30)
+        assert fut.result(timeout=30) is True
+    finally:
+        client.close()

@@ -161,3 +161,72 @@ def test_registered_dataclass_roundtrip():
     s2 = deserialize(serialize(s))
     assert s2 == s
     assert isinstance(s2.owners, tuple)
+
+
+# ---------------------------------------------------------------------------
+# Schema-carrying deserialization of unknown types (ClassCarpenter analog,
+# reference ClassCarpenter.kt:30-447; VERDICT r3 missing #5)
+# ---------------------------------------------------------------------------
+
+def test_carpented_unknown_type_roundtrip():
+    import dataclasses
+
+    from corda_tpu.core.serialization import codec
+
+    @dataclasses.dataclass(frozen=True)
+    class ThirdPartyState:
+        issuer: str
+        quantity: int
+        memo: bytes
+
+    name = "test.carpenter.ThirdPartyState"
+    codec.register_type(name, ThirdPartyState, carry_schema=True)
+    try:
+        blob = codec.serialize(ThirdPartyState("O=Issuer", 42, b"\x01\x02"))
+
+        # simulate a receiver WITHOUT the defining module
+        del codec._REGISTRY[name]
+        del codec._BY_CLASS[ThirdPartyState]
+        got = codec.deserialize(blob)
+        assert type(got) is not ThirdPartyState
+        assert getattr(type(got), "__corda_carpented__", None) == name
+        assert (got.issuer, got.quantity, got.memo) == ("O=Issuer", 42,
+                                                        b"\x01\x02")
+        # the bag re-serializes BIT-EXACTLY (relay/storage round-trip)
+        assert codec.serialize(got) == blob
+        # same schema carpents once; a conflicting schema is rejected
+        assert type(codec.deserialize(blob)) is type(got)
+        with pytest.raises(SerializationError):
+            codec.carpented_class(name, ["different", "fields"])
+
+        # once the real class IS registered, it wins for new decodes
+        codec.register_type(name, ThirdPartyState, carry_schema=True)
+        again = codec.deserialize(blob)
+        assert type(again) is ThirdPartyState
+    finally:
+        codec._REGISTRY.pop(name, None)
+        codec._BY_CLASS.pop(ThirdPartyState, None)
+        codec._SCHEMA_NAMES.pop(name, None)
+        cls_entry = codec._CARPENTED.pop(name, None)
+        if cls_entry is not None:
+            codec._CARPENTED_BY_CLASS.pop(cls_entry[0], None)
+
+
+def test_carpenter_rejects_hostile_field_names():
+    from corda_tpu.core.serialization import codec
+    with pytest.raises(SerializationError):
+        codec.carpented_class("evil.Type", ["__class__"])
+    with pytest.raises(SerializationError):
+        codec.carpented_class("evil.Type2", ["not an identifier!"])
+
+
+def test_plain_unknown_type_still_rejected():
+    """The whitelist stays authoritative for schema-LESS objects."""
+    import msgpack
+
+    from corda_tpu.core.serialization import codec
+    wire = msgpack.ExtType(codec._EXT_OBJ,
+                           codec._packb(["no.such.Type", [1, 2]]))
+    blob = codec._MAGIC + codec._packb(wire)
+    with pytest.raises(SerializationError):
+        codec.deserialize(blob)
