@@ -20,6 +20,7 @@ edge cases like A = identity or doublings need no branches).
 """
 from __future__ import annotations
 
+import functools
 import hashlib
 
 import jax
@@ -112,6 +113,145 @@ def shamir_ladder(bits1, bits2, P1, P2):
     return acc
 
 
+# ---------------------------------------------------------------------------
+# Windowed ladder: constant-B Niels table + 2-bit per-item A windows
+# (the ed25519 sibling of weierstrass.hybrid_ladder_wide — no endomorphism
+# on edwards25519, so the doubles stay at 256, but the adds collapse from
+# 256 to 128 A adds + 256/w mixed B adds)
+# ---------------------------------------------------------------------------
+
+#: Constant-base window width: one mixed B add per w bits from a 2^w-entry
+#: Niels table. 256 = 16x16 divides exactly; the table is ~6MB of u16.
+B_WINDOW = 16
+
+_B_TABLES: dict[int, tuple] = {}
+_B_TABLES_DEV: dict[int, tuple] = {}
+
+
+def _b_window_table(w: int):
+    """(2^w, NLIMB) u16 arrays (y+x, y−x, 2d·x·y) of wa·B — the Niels/Duif
+    precomputed form the mixed add consumes. Row 0 (the identity) is
+    naturally (1, 1, 0): valid input to the mixed add, NO flag machinery
+    (unlike the Weierstrass table's Z=0 rows). Built host-side with one
+    Montgomery batch inversion for all the affine-add denominators."""
+    if w in _B_TABLES:
+        return _B_TABLES[w]
+    span = 1 << w
+    # chain wa·B in EXTENDED coordinates (no inversion per add), then one
+    # Montgomery batch inversion of every Z to land affine
+    from .weierstrass import _batch_modinv
+    ext = [None] * span
+    ext[1] = ecmath.ed_to_extended(ecmath.ED_B)
+    for wa in range(2, span):
+        ext[wa] = ecmath.ed_point_add(ext[wa - 1], ext[1])
+    zinvs = iter(_batch_modinv([e[2] for e in ext[1:]], P))
+    ps, ms, tds = [1], [1], [0]   # identity row: (1, 1, 0)
+    for e in ext[1:]:
+        zi = next(zinvs)
+        x = e[0] * zi % P
+        y = e[1] * zi % P
+        ps.append((y + x) % P)
+        ms.append((y - x) % P)
+        tds.append(ecmath.ED_D2 * x % P * y % P)
+    tab = tuple(F.to_limbs(v).astype(np.uint16) for v in (ps, ms, tds))
+    _B_TABLES[w] = tab
+    return tab
+
+
+def b_table_device(w: int = B_WINDOW):
+    """The Niels base table as committed device arrays (kernel ARGUMENTS,
+    not baked constants — see weierstrass.g_window_table_device)."""
+    if w not in _B_TABLES_DEV:
+        _B_TABLES_DEV[w] = tuple(jax.device_put(t)
+                                 for t in _b_window_table(w))
+    return _B_TABLES_DEV[w]
+
+
+def madd_niels(Pt, tab_p, tab_m, tab_td):
+    """Mixed add of a precomputed Niels point (y+x, y−x, 2dxy), Z2 = 1 —
+    7 full muls vs the unified add's 9 (add-2008-hwcd-3 with the Z2
+    product and both input rotations folded into the table entries).
+    Complete for every accumulator, identity rows (1, 1, 0) included."""
+    x1, y1, z1, t1 = Pt
+    a = F.mul(F.sub(y1, x1, P), tab_m, P)
+    b = F.mul(F.add(y1, x1, P), tab_p, P)
+    c = F.mul(t1, tab_td, P)
+    d = F.mul_const(z1, 2, P)
+    e = F.sub(b, a, P)
+    f = F.sub(d, c, P)
+    g = F.add(d, c, P)
+    h = F.add(b, a, P)
+    return (F.mul(e, f, P), F.mul(g, h, P), F.mul(f, g, P), F.mul(e, h, P))
+
+
+def windowed_ladder(b_idx, a_digits, neg_a, btab, w: int):
+    """[s]B + [k](-A): per outer step, ``w`` bits — w doubles, w/2 A adds
+    (2-bit per-item windows over {0,-A,-2A,-3A}), ONE Niels mixed B add
+    gathered from the 2^w-entry constant table.
+
+    ``b_idx``: (256/w, B) table indices; ``a_digits``: (256/w, w/2, B)
+    2-bit digits of k; ``neg_a``: extended -A batch; ``btab``: the three
+    (2^w, NLIMB) table arrays."""
+    tab_p, tab_m, tab_td = btab
+    batch_shape = neg_a[0].shape[:-1]
+    Pid = identity(batch_shape)
+    a2 = double(neg_a)
+    a_tab = (Pid, neg_a, a2, add(a2, neg_a))   # {0,-A,-2A,-3A}
+
+    def a_addend(dig):
+        return _select4(dig, *a_tab)
+
+    def b_add(acc, bi):
+        return madd_niels(acc, tab_p[bi].astype(jnp.uint64),
+                          tab_m[bi].astype(jnp.uint64),
+                          tab_td[bi].astype(jnp.uint64))
+
+    def a_step(acc, dig):
+        acc = double(double(acc))
+        return add(acc, a_addend(dig)), None
+
+    def step(acc, ins):
+        bi, digs = ins
+        acc, _ = jax.lax.scan(a_step, acc, digs)
+        return b_add(acc, bi), None
+
+    # peel step 0: the accumulator is the identity, so the leading
+    # double-double-add collapses to selecting the first A addend
+    acc = a_addend(a_digits[0][0])
+    acc, _ = jax.lax.scan(a_step, acc, a_digits[0][1:])
+    acc = b_add(acc, b_idx[0])
+    acc, _ = jax.lax.scan(step, acc, (b_idx[1:], a_digits[1:]))
+    return acc
+
+
+def verify_core_windowed(b_idx, a_digits, neg_a, r_y, r_sign,
+                         tab_p, tab_m, tab_td, w: int):
+    """ok[i] = compress([s]B + [k](-A)) == wire R bytes — RFC 8032
+    re-encoding equivalence: the wire y (canonical, host-range-checked)
+    and sign bit are compared against the DEVICE-computed affine result,
+    so the host never pays the per-item modular sqrt of decompressing R.
+    One batched Fermat inversion (a lax.scan pow) lands the affine
+    coordinates; Z is never 0 for the complete extended formulas."""
+    b_idx = jnp.asarray(b_idx, jnp.int32)
+    a_digits = jnp.asarray(a_digits, jnp.uint64)
+    neg_a = tuple(jnp.asarray(c, jnp.uint64) for c in neg_a)
+    r_y = jnp.asarray(r_y, jnp.uint64)
+    r_sign = jnp.asarray(r_sign)
+    acc = windowed_ladder(b_idx, a_digits, neg_a,
+                          (tab_p, tab_m, tab_td), w)
+    x, y, z, _ = acc
+    zi = F.inv(z, P)
+    x_aff = F.canon(F.mul(x, zi, P), P)
+    y_aff = F.canon(F.mul(y, zi, P), P)
+    ok_y = jnp.all(y_aff == r_y, axis=-1)
+    ok_sign = (x_aff[..., 0] & 1) == r_sign
+    return ok_y & ok_sign
+
+
+_verify_kernel_windowed = jax.jit(verify_core_windowed,
+                                  static_argnames=("w",))
+
+
 def verify_core(s_bits, k_bits, neg_a, r_affine):
     """Device core: ok[i] = ([s]B + [k](-A) == R) per batch item.
 
@@ -150,6 +290,56 @@ def _pack_point_ext(pts) -> tuple:
     return tuple(jnp.asarray(v) for v in (xs, ys, zs, ts))
 
 
+@functools.lru_cache(maxsize=65536)
+def _decompress_a(pub: bytes):
+    """Per-signer decompression cache: the sqrt inside ed_point_decompress
+    is ~2 modpows of host bigint work per call, and a node verifies the
+    same signers' keys over and over (the service path is host-CPU-bound)."""
+    return ecmath.ed_point_decompress(pub)
+
+
+def _precheck_items(items, decompress_r: bool):
+    """ONE host-side structural-check + scalar-derivation loop for both
+    kernel preps. ``decompress_r=True`` (plain ladder) additionally pays
+    the modular sqrt to materialize R as a point; the windowed kernel
+    verifies by RE-ENCODING the computed point (RFC 8032 equivalence), so
+    its prep only range-checks the raw y — the R sqrt was ~0.3ms of host
+    bigint per ITEM, the dominant service-path cost for the default
+    scheme. Returns (precheck, A points, R points|None, R y-ints,
+    R sign bits, s scalars, k scalars)."""
+    n = len(items)
+    precheck = np.ones(n, dtype=bool)
+    a_pts, r_pts, r_ys, r_signs, ss, ks = [], [], [], [], [], []
+    for i, (pub, sig, msg) in enumerate(items):
+        ok = len(sig) == 64
+        R = None
+        if ok:
+            r_enc = int.from_bytes(sig[:32], "little")
+            r_y = r_enc & ((1 << 255) - 1)
+            r_sign = r_enc >> 255
+            s = int.from_bytes(sig[32:], "little")
+            A = _decompress_a(bytes(pub))
+            # non-canonical y (>= p) rejects exactly like a failed
+            # decompression — the oracle's ed_point_decompress does
+            ok = A is not None and r_y < P and s < ecmath.ED_L
+            if ok and decompress_r:
+                R = ecmath.ed_point_decompress(sig[:32])
+                ok = R is not None
+        if not ok:
+            precheck[i] = False
+            A, R, r_y, r_sign, s, k = ecmath.ED_B, ecmath.ED_B, 1, 0, 0, 0
+        else:
+            h = hashlib.sha512(sig[:32] + pub + msg).digest()
+            k = int.from_bytes(h, "little") % ecmath.ED_L
+        a_pts.append(A)
+        r_pts.append(R)
+        r_ys.append(r_y)
+        r_signs.append(r_sign)
+        ss.append(s)
+        ks.append(k)
+    return precheck, a_pts, r_pts, r_ys, r_signs, ss, ks
+
+
 def prepare_batch(items: list[tuple[bytes, bytes, bytes]]):
     """Host prep: (public_key32, signature64, message) triples → kernel inputs.
 
@@ -159,33 +349,38 @@ def prepare_batch(items: list[tuple[bytes, bytes, bytes]]):
     we map to verdict False and let the caller decide). Failed items are
     substituted with the base point so shapes stay static.
     """
-    n = len(items)
-    precheck = np.ones(n, dtype=bool)
-    a_pts, r_pts, ss, ks = [], [], [], []
-    for i, (pub, sig, msg) in enumerate(items):
-        ok = len(sig) == 64
-        A = ecmath.ed_point_decompress(pub) if ok else None
-        R = ecmath.ed_point_decompress(sig[:32]) if ok else None
-        s = int.from_bytes(sig[32:], "little") if ok else 0
-        if A is None or R is None or s >= ecmath.ED_L:
-            ok = False
-        if not ok:
-            precheck[i] = False
-            A, R, s = ecmath.ED_B, ecmath.ED_B, 0
-            k = 0
-        else:
-            h = hashlib.sha512(sig[:32] + pub + msg).digest()
-            k = int.from_bytes(h, "little") % ecmath.ED_L
-        a_pts.append(A)
-        r_pts.append(R)
-        ss.append(s)
-        ks.append(k)
+    precheck, a_pts, r_pts, _, _, ss, ks = _precheck_items(
+        items, decompress_r=True)
     neg_a = _pack_point_ext([(P - x, y) for x, y in a_pts])
     rx = jnp.asarray(F.to_limbs([p[0] for p in r_pts]).astype(np.uint16))
     ry = jnp.asarray(F.to_limbs([p[1] for p in r_pts]).astype(np.uint16))
     s_bits = jnp.asarray(F.scalars_to_bits(ss))
     k_bits = jnp.asarray(F.scalars_to_bits(ks))
     return s_bits, k_bits, neg_a, (rx, ry), precheck
+
+
+def prepare_batch_windowed(items: list[tuple[bytes, bytes, bytes]],
+                           w: int = B_WINDOW, device_tables: bool = True):
+    """Host prep for the windowed kernel: s → w-bit constant-B table
+    indices, k → 2-bit A-window digits grouped per outer step, -A extended,
+    R as its RAW canonical y + sign bit (no host decompression — the
+    kernel re-encodes), plus the device-committed Niels table (appended
+    before precheck so ``*args, precheck`` callers pass straight through).
+    Mesh callers pass ``device_tables=False`` and supply their own
+    replicated table copies instead (no stranded single-device upload)."""
+    from .weierstrass import _bits_to_w_windows, _bits_to_windows
+    precheck, a_pts, _, r_ys, r_signs, ss, ks = _precheck_items(
+        items, decompress_r=False)
+    neg_a = _pack_point_ext([(P - x, y) for x, y in a_pts])
+    r_y = jnp.asarray(F.to_limbs(r_ys).astype(np.uint16))
+    r_sign = jnp.asarray(np.asarray(r_signs, dtype=np.uint8))
+    b_idx = _bits_to_w_windows(F.scalars_to_bits(ss), w).astype(np.int32)
+    digs = _bits_to_windows(F.scalars_to_bits(ks)).astype(np.uint8)
+    a_digits = digs.reshape(256 // w, w // 2, *digs.shape[1:])
+    head = (jnp.asarray(b_idx), jnp.asarray(a_digits), neg_a, r_y, r_sign)
+    if device_tables:
+        return (*head, *b_table_device(w), precheck)
+    return (*head, precheck)
 
 
 
@@ -202,13 +397,14 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
 
 def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
     """Dispatch without forcing (see weierstrass.verify_batch_async): the
-    device computes while the caller preps the next batch."""
+    device computes while the caller preps the next batch. Rides the
+    windowed constant-B kernel — the fastest measured path."""
     n = len(items)
     if n == 0:
         return (None, np.zeros(0, dtype=bool), 0)
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
-    s_bits, k_bits, neg_a, r_affine, precheck = prepare_batch(padded)
-    return (_verify_kernel(s_bits, k_bits, neg_a, r_affine), precheck, n)
+    *args, precheck = prepare_batch_windowed(padded, B_WINDOW)
+    return (_verify_kernel_windowed(*args, w=B_WINDOW), precheck, n)
 
 
 def finish_batch(pending) -> np.ndarray:

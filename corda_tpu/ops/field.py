@@ -592,7 +592,40 @@ def pow_const(a, e: int, p: int):
 
 def inv(a, p: int):
     """Modular inverse via Fermat (a^(p-2)); a must be non-zero (inv(0)=0)."""
+    if p == P25519:
+        return inv25519(a)
     return pow_const(a, p - 2, p)
+
+
+def _sqr_n(a, n: int, p: int):
+    """n successive squarings as a lax.scan (graph stays one-step-sized)."""
+    if n == 1:
+        return sqr(a, p)
+    out, _ = jax.lax.scan(lambda c, _x: (sqr(c, p), None), a, None, length=n)
+    return out
+
+
+def inv25519(a):
+    """a^(p-2) mod 2^255-19 via the standard curve25519 addition chain:
+    254 squarings + 11 multiplies, versus ~250 multiplies for the generic
+    square-and-multiply over the dense exponent (p-2 = 2^255-21 is almost
+    all ones). The ed25519 re-encoding epilogue pays one of these per
+    batch."""
+    p = P25519
+    z2 = sqr(a, p)                       # 2
+    z8 = _sqr_n(z2, 2, p)                # 8
+    z9 = mul(z8, a, p)                   # 9
+    z11 = mul(z9, z2, p)                 # 11
+    z22 = sqr(z11, p)                    # 22
+    z_5_0 = mul(z22, z9, p)              # 2^5 - 1
+    z_10_0 = mul(_sqr_n(z_5_0, 5, p), z_5_0, p)      # 2^10 - 1
+    z_20_0 = mul(_sqr_n(z_10_0, 10, p), z_10_0, p)   # 2^20 - 1
+    z_40_0 = mul(_sqr_n(z_20_0, 20, p), z_20_0, p)   # 2^40 - 1
+    z_50_0 = mul(_sqr_n(z_40_0, 10, p), z_10_0, p)   # 2^50 - 1
+    z_100_0 = mul(_sqr_n(z_50_0, 50, p), z_50_0, p)  # 2^100 - 1
+    z_200_0 = mul(_sqr_n(z_100_0, 100, p), z_100_0, p)  # 2^200 - 1
+    z_250_0 = mul(_sqr_n(z_200_0, 50, p), z_50_0, p)    # 2^250 - 1
+    return mul(_sqr_n(z_250_0, 5, p), z11, p)        # 2^255 - 21
 
 
 # ---------------------------------------------------------------------------

@@ -64,6 +64,25 @@ def sharded_ed25519_verify(mesh: Mesh):
     return jax.jit(shmapped)
 
 
+def sharded_ed25519_verify_windowed(mesh: Mesh):
+    """Batch-sharded Ed25519 verify over the WINDOWED constant-B kernel —
+    the production service path (ops.ed25519.verify_core_windowed): Niels
+    base table replicated per chip, batch axis sharded.
+
+    Input layout (from ops.ed25519.prepare_batch_windowed): b_idx
+    (256/w, B); a_digits (256/w, w/2, B); neg_a 4×(B, 16); r_y (B, 16);
+    r_sign (B,); the three Niels table arrays replicated."""
+    core = functools.partial(ed_ops.verify_core_windowed, w=ed_ops.B_WINDOW)
+    shmapped = jax.shard_map(
+        core, mesh=mesh,
+        in_specs=(P(None, AXIS), P(None, None, AXIS),
+                  (P(AXIS, None),) * 4, P(AXIS, None), P(AXIS),
+                  P(None, None), P(None, None), P(None, None)),
+        out_specs=P(AXIS),
+        check_vma=False)  # see sharded_ed25519_verify
+    return jax.jit(shmapped)
+
+
 def sharded_ecdsa_verify(mesh: Mesh, curve_name: str):
     """Same as sharded_ed25519_verify for the Weierstrass ECDSA kernel.
 
@@ -141,16 +160,23 @@ def _pad_to_mesh_bucket(n: int, mesh: Mesh) -> int:
 def sharded_verify_batch_ed25519(mesh: Mesh, items, _cache={}):
     """[(pub32, sig64, msg)] → bool verdicts (B,), the batch dp-sharded over
     ``mesh`` — the drop-in mesh backend for the SignatureBatcher
-    (ops.ed25519.verify_batch semantics, N chips instead of one)."""
+    (ops.ed25519.verify_batch semantics, N chips instead of one). Rides
+    the windowed constant-B kernel with the Niels table replicated once
+    per mesh."""
     n = len(items)
     if n == 0:
         return np.zeros(0, dtype=bool)
     padded = items + [items[-1]] * (_pad_to_mesh_bucket(n, mesh) - n)
-    s_bits, k_bits, neg_a, r_affine, precheck = ed_ops.prepare_batch(padded)
+    *args, precheck = ed_ops.prepare_batch_windowed(
+        padded, ed_ops.B_WINDOW, device_tables=False)
     key = ("ed25519", id(mesh))
     if key not in _cache:
-        _cache[key] = sharded_ed25519_verify(mesh)
-    ok = np.asarray(_cache[key](s_bits, k_bits, neg_a, r_affine))
+        rep = jax.NamedSharding(mesh, P())
+        tabs = tuple(jax.device_put(t, rep)
+                     for t in ed_ops._b_window_table(ed_ops.B_WINDOW))
+        _cache[key] = (sharded_ed25519_verify_windowed(mesh), tabs)
+    fn, tabs = _cache[key]
+    ok = np.asarray(fn(*args, *tabs))
     return (ok & precheck)[:n]
 
 

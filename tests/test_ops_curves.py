@@ -89,6 +89,27 @@ def test_ed25519_malformed_inputs():
     assert list(got) == [False, False, False, True]
 
 
+def test_ed25519_r_encoding_edge_cases():
+    """The re-encoding acceptance's R-specific rejections, each checked
+    against the host oracle: a flipped x-sign bit (same y, DIFFERENT
+    point), a non-canonical y (>= p, must reject like a failed
+    decompression), and an off-curve y."""
+    seed = RNG.bytes(32)
+    pub = ecmath.ed25519_public_key(seed)
+    msg = b"sign-bit coverage"
+    sig = ecmath.ed25519_sign(seed, msg)
+    flipped_sign = sig[:31] + bytes([sig[31] ^ 0x80]) + sig[32:]
+    non_canonical = (2**255 - 10).to_bytes(32, "little") + sig[32:]
+    # y = 2 is not on the curve (no x satisfies the equation)
+    off_curve = (2).to_bytes(32, "little") + sig[32:]
+    items = [(pub, s, msg)
+             for s in (sig, flipped_sign, non_canonical, off_curve)]
+    want = [ecmath.ed25519_verify(pub, msg, s)
+            for _, s, _ in items]
+    assert want == [True, False, False, False]  # oracle sanity
+    assert list(ed_ops.verify_batch(items)) == want
+
+
 # ---------------------------------------------------------------------------
 # ECDSA secp256k1 / secp256r1
 # ---------------------------------------------------------------------------
