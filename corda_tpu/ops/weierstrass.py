@@ -166,14 +166,8 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     p = curve.p
     a = curve.a % p
     b3 = 3 * curve.b % p
-    neg_a = p - a           # |a| when a is a small negative constant
-    small = F.MUL_CONST_MAX
-    b3_c = None if b3 < small else _const(b3, p)
-    if a == 0 and b3 < small:
+    if a == 0 and b3 < F.MUL_CONST_MAX:
         return _add_k1(Pt, Qt, p, b3)
-
-    def mul_b3(x):
-        return F.mul_const(x, b3, p) if b3_c is None else F.mul(x, b3_c, p)
 
     def mul2(x, y):
         return F.sqr(x, p) if doubling else F.mul(x, y, p)
@@ -196,6 +190,22 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     t5 = mul2_of_sums(Y1, Z1, Y2, Z2)
     X3 = F.add(t1, t2, p)
     t5 = F.sub(t5, X3, p)
+    return _rcb_finish(t0, t1, t2, t3, t4, t5, curve)
+
+
+def _rcb_finish(t0, t1, t2, t3, t4, t5, curve: WeierstrassCurve):
+    """The curve-constant tail of RCB Algorithm 1 after the six symmetric
+    cross products — shared by the full add and the mixed (Z2 = 1) add."""
+    p = curve.p
+    a = curve.a % p
+    b3 = 3 * curve.b % p
+    neg_a = p - a
+    small = F.MUL_CONST_MAX
+    b3_c = None if b3 < small else _const(b3, p)
+
+    def mul_b3(x):
+        return F.mul_const(x, b3, p) if b3_c is None else F.mul(x, b3_c, p)
+
     if neg_a < small:
         # a = -|a|:  Z3 = b3·t2 - |a|·t4 ;  t1' = 3t0 - |a|·t2 ;
         # t4' = b3·t4 + a·(t0 - a·t2) = b3·t4 - |a|·(t0 + |a|·t2)
@@ -230,6 +240,25 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     Z3 = F.mul(t5, Z3, p)
     Z3 = F.add(Z3, t0, p)
     return (X3, Y3, Z3)
+
+
+def _madd_w(Pt, Qa, curve: WeierstrassCurve):
+    """Complete MIXED (Z2 = 1) RCB addition for a GENERAL-a curve
+    (secp256r1's a = -3 path): with an affine addend the symmetric cross
+    products collapse host-side — t2 = Z1, t4 = X1 + Z1·X2,
+    t5 = Y1 + Z1·Y2 — saving three of the twelve full products. Complete
+    for every projective P1; NOT valid for an identity addend (the
+    windowed ladder's table carries a validity flag)."""
+    X1, Y1, Z1 = Pt
+    X2, Y2 = Qa
+    p = curve.p
+    t0 = F.mul(X1, X2, p)
+    t1 = F.mul(Y1, Y2, p)
+    t3 = F.mul_of_sums(X1, Y1, X2, Y2, p)
+    t3 = F.sub(t3, F.add(t0, t1, p), p)
+    t4 = F.norm(F.col_acc(p, plus=[F.mul_cols(Z1, X2), F.rel(X1)]), p)
+    t5 = F.norm(F.col_acc(p, plus=[F.mul_cols(Z1, Y2), F.rel(Y1)]), p)
+    return _rcb_finish(t0, t1, Z1, t3, t4, t5, curve)
 
 
 def dbl(Pt, curve: WeierstrassCurve):
@@ -585,6 +614,176 @@ def _g_window_table_wide(curve: WeierstrassCurve, w: int):
     return tab
 
 
+_G_TABLES_1S: dict[tuple, tuple] = {}
+_G_TABLES_1S_DEV: dict[tuple, tuple] = {}
+
+
+def _g_window_table_single(curve: WeierstrassCurve, w: int):
+    """Single-scalar constant-G window table for curves WITHOUT an
+    endomorphism (secp256r1): u16 affine X/Y arrays of shape (2^w, NLIMB)
+    plus a u8 validity flag (row 0 = identity). Entry wa = wa·G.
+
+    Built as a JACOBIAN host chain (no inversion per add) landed affine by
+    ONE Montgomery batch inversion — 2^16 rows in ~1s."""
+    key = (curve.name, w)
+    if key in _G_TABLES_1S:
+        return _G_TABLES_1S[key]
+    p = curve.p
+    a = curve.a % p
+    gx, gy = curve.g
+    span = 1 << w
+
+    def jac_dbl(X1, Y1, Z1):
+        """General-a Jacobian doubling (dbl-2007-bl) — for 2·G, where the
+        mixed add would be the exceptional equal-points case."""
+        A = X1 * X1 % p
+        B = Y1 * Y1 % p
+        C = B * B % p
+        D = 2 * ((X1 + B) * (X1 + B) - A - C) % p
+        E = (3 * A + a * pow(Z1, 4, p)) % p
+        Fv = E * E % p
+        X3 = (Fv - 2 * D) % p
+        Y3 = (E * (D - X3) - 8 * C) % p
+        Z3 = 2 * Y1 * Z1 % p
+        return X3, Y3, Z3
+
+    def jac_madd(X1, Y1, Z1):
+        """(X1:Y1:Z1) Jacobian + G affine (madd-2007-bl); the chain from
+        3·G on never hits the exceptional cases (wa·G = ±G needs
+        tiny-order points)."""
+        Z1Z1 = Z1 * Z1 % p
+        U2 = gx * Z1Z1 % p
+        S2 = gy * Z1 % p * Z1Z1 % p
+        H = (U2 - X1) % p
+        assert H != 0, "chain hit an exceptional mixed add"
+        HH = H * H % p
+        I = 4 * HH % p
+        J = H * I % p
+        r = 2 * (S2 - Y1) % p
+        V = X1 * I % p
+        X3 = (r * r - J - 2 * V) % p
+        Y3 = (r * (V - X3) - 2 * Y1 * J) % p
+        Z3 = ((Z1 + H) * (Z1 + H) - Z1Z1 - HH) % p
+        return X3, Y3, Z3
+
+    chain = [None, (gx, gy, 1)]
+    if span > 2:
+        chain.append(jac_dbl(*chain[1]))
+    for _ in range(3, span):
+        chain.append(jac_madd(*chain[-1]))
+    zinvs = iter(_batch_modinv([c[2] for c in chain[1:]], p))
+    xs, ys, flags = [0], [0], [0]          # identity row
+    for X, Y, Z in chain[1:]:
+        zi = next(zinvs)
+        zi2 = zi * zi % p
+        xs.append(X * zi2 % p)
+        ys.append(Y * zi2 % p * zi % p)
+        flags.append(1)
+    tab = (F.to_limbs(xs).astype(np.uint16), F.to_limbs(ys).astype(np.uint16),
+           np.asarray(flags, dtype=np.uint8))
+    _G_TABLES_1S[key] = tab
+    return tab
+
+
+def g_window_table_single_device(curve: WeierstrassCurve, w: int):
+    key = (curve.name, w)
+    if key not in _G_TABLES_1S_DEV:
+        _G_TABLES_1S_DEV[key] = tuple(
+            jax.device_put(t) for t in _g_window_table_single(curve, w))
+    return _G_TABLES_1S_DEV[key]
+
+
+#: Constant-G window width for the single-scalar windowed ladder (r1).
+R1_G_WINDOW = 16
+
+
+def windowed_ladder_single(g_idx, q_digits, Q, gtab,
+                           curve: WeierstrassCurve, w: int):
+    """[u1]G + [u2]Q for a curve without an endomorphism: per outer step,
+    ``w`` bits — w doublings, w/2 Q adds (2-bit per-item windows over
+    {0, Q, 2Q, 3Q}) and ONE mixed G add gathered from the 2^w-entry
+    affine table (flag-selected identity rows). The r1 sibling of
+    hybrid_ladder_wide; it replaces the 256-add plain Shamir ladder.
+
+    ``g_idx``: (256/w, B); ``q_digits``: (256/w, w/2, B) 2-bit digits;
+    ``Q``: affine (x, y) limb pair."""
+    tab_x, tab_y, tab_ok = gtab
+    # shape consistency against the static w (a mismatched caller would
+    # otherwise be silently governed by the array shapes alone)
+    assert g_idx.shape[0] * w == 256 and q_digits.shape[1] * 2 == w, \
+        (g_idx.shape, q_digits.shape, w)
+    assert tab_x.shape[0] == 1 << w, (tab_x.shape, w)
+    batch_shape = Q[0].shape[:-1]
+    Pid = identity(batch_shape)
+    one = F.one_like(Q[0])
+    T1 = (Q[0], Q[1], one)
+    T2 = dbl(T1, curve)
+    T3 = _madd_w(T2, Q, curve)
+    q_tab = (Pid, T1, T2, T3)
+
+    def q_addend(dig):
+        return _select4(dig, q_tab)
+
+    def g_add(acc, gi):
+        q2 = (tab_x[gi].astype(jnp.uint64), tab_y[gi].astype(jnp.uint64))
+        added = _madd_w(acc, q2, curve)
+        ok = tab_ok[gi].astype(jnp.bool_)
+        return tuple(F.select(ok, new_c, acc_c)
+                     for new_c, acc_c in zip(added, acc))
+
+    def q_step(acc, dig):
+        acc = dbl(dbl(acc, curve), curve)
+        return add(acc, q_addend(dig), curve), None
+
+    def step(acc, ins):
+        gi, digs = ins
+        acc, _ = jax.lax.scan(q_step, acc, digs)
+        return g_add(acc, gi), None
+
+    # peel step 0 (accumulator starts as the identity)
+    acc = q_addend(q_digits[0][0])
+    acc, _ = jax.lax.scan(q_step, acc, q_digits[0][1:])
+    acc = g_add(acc, g_idx[0])
+    acc, _ = jax.lax.scan(step, acc, (g_idx[1:], q_digits[1:]))
+    return acc
+
+
+def verify_core_windowed_single(g_idx, q_digits, Q, r_limbs, rn_ok,
+                                tab_x, tab_y, tab_ok, curve_name: str,
+                                w: int):
+    g_idx = jnp.asarray(g_idx, jnp.int32)
+    q_digits = jnp.asarray(q_digits, jnp.uint64)
+    Q = tuple(jnp.asarray(c, jnp.uint64) for c in Q)
+    r_limbs = jnp.asarray(r_limbs, jnp.uint64)
+    rn_ok = jnp.asarray(rn_ok).astype(jnp.bool_)
+    curve = CURVES[curve_name]
+    X, Y, Z = windowed_ladder_single(g_idx, q_digits, Q,
+                                     (tab_x, tab_y, tab_ok), curve, w)
+    return _accept_rn(X, Z, r_limbs, rn_ok, curve.p, curve.n)
+
+
+_verify_kernel_windowed_single = jax.jit(
+    verify_core_windowed_single, static_argnames=("curve_name", "w"))
+
+
+def prepare_batch_windowed_single(curve: WeierstrassCurve, items,
+                                  w: int = R1_G_WINDOW):
+    """Host prep for the single-scalar windowed kernel: u1 → w-bit G-table
+    indices, u2 → 2-bit Q digits grouped per outer step, Q affine, r + the
+    r+n-valid flag, the device-committed G table (appended before precheck
+    so ``*args, precheck`` callers pass through)."""
+    precheck, pubs, u1s, u2s, r0, _ = _precheck_and_scalars(curve, items)
+    g_idx = _bits_to_w_windows(F.scalars_to_bits(u1s), w).astype(np.int32)
+    digs = _bits_to_windows(F.scalars_to_bits(u2s)).astype(np.uint8)
+    q_digits = digs.reshape(256 // w, w // 2, *digs.shape[1:])
+    r_limbs = jnp.asarray(F.to_limbs(r0).astype(np.uint16))
+    rn_ok = jnp.asarray(np.asarray(
+        [r + curve.n < curve.p for r in r0], dtype=np.uint8))
+    return (jnp.asarray(g_idx), jnp.asarray(q_digits),
+            _points_to_limbs_affine(pubs), r_limbs, rn_ok,
+            *g_window_table_single_device(curve, w), precheck)
+
+
 _G_TABLES_DEV: dict[tuple, tuple] = {}
 
 
@@ -793,9 +992,11 @@ def verify_batch(curve: WeierstrassCurve,
 
     Pads to a power-of-two bucket (replicating the last item) so the device
     kernel compiles once per bucket size. ``mode``:
-    - "auto": the fastest measured path — "hybrid" for secp256k1, "plain"
-      otherwise (no endomorphism on r1).
+    - "auto": the fastest measured path — "hybrid" (GLV) for secp256k1,
+      "windowed" (constant-G table, no endomorphism) otherwise.
     - "hybrid": GLV half-length ladder with the constant-G gather table.
+    - "windowed": single-scalar constant-G windows + 2-bit Q windows
+      (windowed_ladder_single — the r1 production path).
     - "glv": the all-select GLV ladder (kept for differential testing —
       measured at parity with plain: the 15-select tree eats the saved ops).
     - "plain": the 256-bit two-scalar Shamir ladder.
@@ -805,15 +1006,20 @@ def verify_batch(curve: WeierstrassCurve,
         return np.zeros(0, dtype=bool)
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
     if mode == "auto":
-        mode = "hybrid" if curve.name == "secp256k1" else "plain"
-    if mode not in ("plain", "glv", "hybrid"):
+        mode = "hybrid" if curve.name == "secp256k1" else "windowed"
+    if mode not in ("plain", "glv", "hybrid", "windowed"):
         raise ValueError(f"unknown verify mode {mode!r}")
-    if mode != "plain" and curve.name != "secp256k1":
+    if mode in ("glv", "hybrid") and curve.name != "secp256k1":
         raise ValueError(f"mode {mode!r} requires secp256k1")
     if mode == "hybrid":
         *args, precheck = prepare_batch_hybrid_wide(padded, HYBRID_G_WINDOW)
         ok = np.asarray(_verify_kernel_hybrid_wide(*args,
                                                    g_w=HYBRID_G_WINDOW))
+    elif mode == "windowed":
+        *args, precheck = prepare_batch_windowed_single(curve, padded,
+                                                        R1_G_WINDOW)
+        ok = np.asarray(_verify_kernel_windowed_single(
+            *args, curve_name=curve.name, w=R1_G_WINDOW))
     elif mode == "glv":
         bits4, pts4, r_cands, precheck = prepare_batch_glv(padded)
         ok = np.asarray(_verify_kernel_glv(bits4, pts4, r_cands))
@@ -838,9 +1044,10 @@ def verify_batch_async(curve: WeierstrassCurve,
         *args, precheck = prepare_batch_hybrid_wide(padded, HYBRID_G_WINDOW)
         return (_verify_kernel_hybrid_wide(*args, g_w=HYBRID_G_WINDOW),
                 precheck, n)
-    u1_bits, u2_bits, q_pts, r_cands, precheck = prepare_batch(curve, padded)
-    return (_verify_kernel(u1_bits, u2_bits, q_pts, r_cands, curve.name),
-            precheck, n)
+    *args, precheck = prepare_batch_windowed_single(curve, padded,
+                                                    R1_G_WINDOW)
+    return (_verify_kernel_windowed_single(*args, curve_name=curve.name,
+                                           w=R1_G_WINDOW), precheck, n)
 
 
 def finish_batch(pending) -> np.ndarray:

@@ -152,7 +152,9 @@ def test_wc_add_matches_host(curve):
      # constant makes the cold compile ~4min on CPU, but the persistent
      # .jax_cache (shared by CI/driver runs on this workspace) makes warm
      # runs seconds — an untested-by-default kernel is an unshipped kernel.
-     (ecmath.SECP256R1, "plain")],
+     (ecmath.SECP256R1, "plain"),
+     # the r1 PRODUCTION path: constant-G windows + 2-bit Q windows
+     (ecmath.SECP256R1, "windowed")],
     ids=lambda v: v if isinstance(v, str) else v.name)
 def test_ecdsa_verify_batch(curve, mode):
     items, want = [], []
