@@ -125,7 +125,6 @@ def shamir_ladder(bits1, bits2, P1, P2):
 B_WINDOW = 16
 
 _B_TABLES: dict[int, tuple] = {}
-_B_TABLES_DEV: dict[int, tuple] = {}
 
 
 def _b_window_table(w: int):
@@ -161,10 +160,7 @@ def _b_window_table(w: int):
 def b_table_device(w: int = B_WINDOW):
     """The Niels base table as committed device arrays (kernel ARGUMENTS,
     not baked constants — see weierstrass.g_window_table_device)."""
-    if w not in _B_TABLES_DEV:
-        _B_TABLES_DEV[w] = tuple(jax.device_put(t)
-                                 for t in _b_window_table(w))
-    return _B_TABLES_DEV[w]
+    return F.device_table_cache(("niels_b", w), lambda: _b_window_table(w))
 
 
 def madd_niels(Pt, tab_p, tab_m, tab_td):

@@ -632,6 +632,20 @@ def inv25519(a):
 # Scalar bit decomposition (for curve scalar-mul ladders)
 # ---------------------------------------------------------------------------
 
+_DEVICE_TABLE_CACHE: dict = {}
+
+
+def device_table_cache(key, build):
+    """Generic committed-device-array cache for baked lookup tables (the
+    constant-G / Niels tables): ``build()`` runs once per key, its arrays
+    are device_put once per process, and repeat calls hand back the same
+    committed buffers (zero per-call transfer). Tables are ARGUMENTS to
+    kernels, never HLO constants — multi-MB literals explode compile time."""
+    if key not in _DEVICE_TABLE_CACHE:
+        _DEVICE_TABLE_CACHE[key] = tuple(jax.device_put(t) for t in build())
+    return _DEVICE_TABLE_CACHE[key]
+
+
 def bucket_size(n: int, floor: int = 8) -> int:
     """Next power of two >= n (>= floor). Batch kernels pad to bucket sizes so
     XLA compiles once per bucket, not once per batch length (shared by the

@@ -615,7 +615,6 @@ def _g_window_table_wide(curve: WeierstrassCurve, w: int):
 
 
 _G_TABLES_1S: dict[tuple, tuple] = {}
-_G_TABLES_1S_DEV: dict[tuple, tuple] = {}
 
 
 def _g_window_table_single(curve: WeierstrassCurve, w: int):
@@ -686,11 +685,9 @@ def _g_window_table_single(curve: WeierstrassCurve, w: int):
 
 
 def g_window_table_single_device(curve: WeierstrassCurve, w: int):
-    key = (curve.name, w)
-    if key not in _G_TABLES_1S_DEV:
-        _G_TABLES_1S_DEV[key] = tuple(
-            jax.device_put(t) for t in _g_window_table_single(curve, w))
-    return _G_TABLES_1S_DEV[key]
+    return F.device_table_cache(
+        ("g_single", curve.name, w),
+        lambda: _g_window_table_single(curve, w))
 
 
 #: Constant-G window width for the single-scalar windowed ladder (r1).
@@ -784,9 +781,6 @@ def prepare_batch_windowed_single(curve: WeierstrassCurve, items,
             *g_window_table_single_device(curve, w), precheck)
 
 
-_G_TABLES_DEV: dict[tuple, tuple] = {}
-
-
 def g_window_table_device(curve: WeierstrassCurve, w: int):
     """The affine constant-G table as COMMITTED DEVICE ARRAYS. The table is
     passed to the kernel as arguments, NOT baked in as constants: at w = 8
@@ -794,11 +788,9 @@ def g_window_table_device(curve: WeierstrassCurve, w: int):
     compile time to minutes per process (fatal for CPU test runs). As
     committed jax Arrays the upload happens once per process and repeat
     calls pass the same buffers — same zero-transfer steady state."""
-    key = (curve.name, w)
-    if key not in _G_TABLES_DEV:
-        _G_TABLES_DEV[key] = tuple(
-            jax.device_put(t) for t in _g_window_table_wide(curve, w))
-    return _G_TABLES_DEV[key]
+    return F.device_table_cache(
+        ("g_hybrid", curve.name, w),
+        lambda: _g_window_table_wide(curve, w))
 
 
 def hybrid_ladder_wide(g_idx, q_bits, Qc, Qd, gtab, curve: WeierstrassCurve,
