@@ -64,6 +64,17 @@ class NodeConfiguration:
     # registrations load — the cordapp classpath scan (AbstractNode.kt:201-206)
     cordapps: list = field(default_factory=lambda: ["corda_tpu.finance"])
 
+    def __post_init__(self):
+        # fail at CONSTRUCTION, before a misconfigured node binds sockets,
+        # writes its identity or spawns threads: an OutOfProcess/InMemory
+        # node silently ignoring mesh_devices would boot without the chips
+        # the operator configured (workers take --mesh-devices instead)
+        if self.mesh_devices is not None and self.verifier_type != "Tpu":
+            raise ValueError(
+                "mesh_devices requires verifier_type=Tpu "
+                f"(got {self.verifier_type!r}; for OutOfProcess, "
+                "pass --mesh-devices to the verifier worker)")
+
     @staticmethod
     def load(path: str) -> "NodeConfiguration":
         with open(path) as f:
@@ -216,17 +227,10 @@ class Node:
         return generate_keypair(entropy=seed)
 
     def _make_verifier(self):
+        # mesh_devices/verifier_type consistency is enforced at
+        # NodeConfiguration construction (__post_init__)
         from ..verifier.service import make_verifier_service
         metrics = self.services.monitoring
-        if self.config.mesh_devices is not None \
-                and self.config.verifier_type != "Tpu":
-            # fail loudly BEFORE any backend branch: an OutOfProcess node
-            # silently ignoring mesh_devices would boot without the chips
-            # the operator configured (workers take --mesh-devices instead)
-            raise ValueError(
-                "mesh_devices requires verifier_type=Tpu "
-                f"(got {self.config.verifier_type!r}; for OutOfProcess, "
-                "pass --mesh-devices to the verifier worker)")
         if self.config.verifier_type == "OutOfProcess":
             from ..verifier.out_of_process import (
                 OutOfProcessTransactionVerifierService)

@@ -101,9 +101,16 @@ def _trim(v, bounds):
 
 def _pass(v, bounds):
     """One vectorized carry pass. Exact new bounds:
-    limb'_i = (limb_i & mask) + (limb_{i-1} >> 16)."""
-    lo = v & MASK
-    hi = v >> LIMB_BITS
+    limb'_i = (limb_i & mask) + (limb_{i-1} >> 16).
+
+    When every incoming bound fits u32 the pass runs in uint32 — the TPU
+    VPU is natively 32-bit, so u64 mask/shift/add lower as emulated pairs;
+    the downcast is lossless by the exact bounds and jnp's promotion rules
+    carry the narrow dtype through downstream adds harmlessly."""
+    if max(bounds) < (1 << 32) and v.dtype == jnp.uint64:
+        v = v.astype(jnp.uint32)
+    lo = v & v.dtype.type(MASK)
+    hi = v >> v.dtype.type(LIMB_BITS)
     pad_cfg = [(0, 0)] * (v.ndim - 1)
     v = jnp.pad(lo, pad_cfg + [(0, 1)]) + jnp.pad(hi, pad_cfg + [(1, 0)])
     nb = [min(b, MASK) for b in bounds] + [0]
@@ -126,6 +133,8 @@ def _fold_bounds(bounds, c_limbs):
 
 def _fold_once(v, bounds, c_limbs):
     """lo + hi·c for a width>16 value (split at bit 256). Exact bounds."""
+    if v.dtype != jnp.uint64:       # a u32 carry pass may have narrowed v
+        v = v.astype(jnp.uint64)
     lo = v[..., :NLIMB]
     hi, hib = v[..., NLIMB:], bounds[NLIMB:]
     nh = len(hib)
@@ -169,6 +178,11 @@ def _normalize(v, bounds, p: int):
                 v, bounds = _pass(v, bounds)
             continue
         if all(b <= t for b, t in zip(bounds, _CONTRACT)):
+            # contract outputs are uniformly u64: scan carries and DUS
+            # accumulators require exact dtype agreement, so the u32 pass
+            # narrowing stays internal to the walk
+            if v.dtype != jnp.uint64:
+                v = v.astype(jnp.uint64)
             return v, bounds
         v, bounds = _pass(v, bounds)
     raise AssertionError("field normalization failed to converge")
