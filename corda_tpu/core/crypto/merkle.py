@@ -5,7 +5,8 @@ next power of two, node hash = single SHA-256 of the 64-byte concatenation) and
 PartialMerkleTree.kt (tear-off proofs used by FilteredTransaction and oracles).
 
 The batched device implementation (leaf hashing + level reduction as JAX kernels,
-cross-chip combine via collectives) lives in ``corda_tpu.ops.merkle`` and is tested
+cross-chip combine via collectives) lives in ``corda_tpu.ops.sha256``
+(``merkle_root``; sharded variant ``corda_tpu.parallel.sharded``) and is tested
 bit-exact against this module.
 """
 from __future__ import annotations

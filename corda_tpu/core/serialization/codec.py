@@ -115,6 +115,11 @@ def register_type(name: str, cls: type,
 #: instances pin them, so eviction would fork a name across two classes —
 #: refuse instead (no legitimate peer set ships thousands of state types).
 _CARPENTED_MAX = 4096
+#: Cap on fields per carpented schema: make_dataclass execs a class body
+#: sized by the field count, and carpented classes are pinned for the
+#: process lifetime — an unbounded count is a wire-reachable memory/CPU
+#: sink. No legitimate state type approaches this.
+_CARPENTED_MAX_FIELDS = 256
 
 
 def carpented_class(name: str, field_names: list[str]) -> type:
@@ -141,6 +146,10 @@ def carpented_class(name: str, field_names: list[str]) -> type:
         raise SerializationError(
             f"Carpented-type limit ({_CARPENTED_MAX}) reached; "
             f"refusing to synthesize {name!r}")
+    if len(field_names) > _CARPENTED_MAX_FIELDS:
+        raise SerializationError(
+            f"Carpented schema for {name!r} has {len(field_names)} fields "
+            f"(limit {_CARPENTED_MAX_FIELDS})")
     seen = set()
     for fn in field_names:
         if (not isinstance(fn, str) or not fn.isidentifier()
@@ -280,14 +289,35 @@ def from_wire(wire: Any) -> Any:
             return from_fields([from_wire(f) for f in fields])
         if code == _EXT_OBJ_SCHEMA:
             name, field_names, fields = _unpackb(data)
-            entry = _REGISTRY.get(name)
-            if entry is not None:       # the real class is known: it wins
-                _, _, from_fields = entry
-                return from_fields([from_wire(f) for f in fields])
             if len(field_names) != len(fields):
                 raise SerializationError(
                     f"Schema'd object {name!r}: {len(field_names)} names "
                     f"vs {len(fields)} fields")
+            entry = _REGISTRY.get(name)
+            if entry is not None:       # the real class is known: it wins
+                cls, _, from_fields = entry
+                # Bind by NAME against the local declaration, never by wire
+                # position: a peer whose version declares fields in a
+                # different order (schema skew) must not silently bind
+                # values to the wrong attributes.
+                local = _SCHEMA_NAMES.get(name)
+                if local is None and dataclasses.is_dataclass(cls):
+                    local = [f.name for f in dataclasses.fields(cls)]
+                if local is not None and list(field_names) != local:
+                    if sorted(field_names) == sorted(local):
+                        by_name = dict(zip(field_names, fields))
+                        fields = [by_name[n] for n in local]
+                    else:
+                        raise SerializationError(
+                            f"Schema'd object {name!r}: carried fields "
+                            f"{sorted(field_names)} do not match local "
+                            f"declaration {sorted(local)}")
+                try:
+                    return from_fields([from_wire(f) for f in fields])
+                except TypeError as e:
+                    raise SerializationError(
+                        f"Schema'd object {name!r} does not fit local "
+                        f"class: {e}") from e
             cls = carpented_class(name, field_names)
             return cls(*[_freeze(from_wire(f)) for f in fields])
         raise SerializationError(f"Unknown ext code {code}")

@@ -8,7 +8,8 @@ Reference parity: WireTransaction.kt:27-120 and MerkleTransaction.kt:16-60:
 - ``id`` = root of the Merkle tree over those leaf hashes.
 
 The device-accelerated path computes the same leaf hashes and tree on TPU
-(``corda_tpu.ops.merkle``) — bit-exact by construction against this module.
+(``corda_tpu.ops.sha256.merkle_root``) — bit-exact by construction against
+this module.
 """
 from __future__ import annotations
 

@@ -453,14 +453,20 @@ class StateMachineManager:
         self._awaiting_external += 1
         fut.add_done_callback(
             lambda f: self._post_external(
-                lambda: self._on_verify_done(fsm, f)))
+                lambda: self._on_verify_done(fsm, f, request)))
         return _PARK
 
-    def _on_verify_done(self, fsm: FlowStateMachine, fut: Future) -> None:
+    def _on_verify_done(self, fsm: FlowStateMachine, fut: Future,
+                        request: Verify) -> None:
         """Node-thread continuation of a Verify park (via drain_external)."""
         self._awaiting_external -= 1
         if fsm.done or fsm.run_id not in self.flows:
             return   # flow failed/completed meanwhile (e.g. session error)
+        if fsm.parked_on is not request:
+            # Same identity guard as wake_timers: a stale or duplicate
+            # future completion (double-invoked callback, flow already
+            # resumed by another path) must not resume at the wrong yield.
+            return
         err = fut.exception()
         if err is None:
             fsm.response_log.append(("value", None))
@@ -802,17 +808,56 @@ def _error_payload(exc: Exception):
     return [f"{type(exc).__module__}:{type(exc).__qualname__}", str(exc)]
 
 
+#: Modules whose Exception types may be reconstructed from a checkpoint
+#: log. A fixed list (not a dynamic import of whatever 'module:qualname'
+#: the payload names): checkpoint storage or a session error must not be
+#: able to trigger arbitrary import side effects or invoke arbitrary
+#: one-string-arg callables — mirrors the reference's checkpoint class
+#: restrictions (CheckpointSerializationScheme).
+_ERROR_MODULES = (
+    "corda_tpu.flows.api",
+    "corda_tpu.flows.library",
+    "corda_tpu.flows.state_replacement",
+    "corda_tpu.flows.contract_upgrade",
+    "corda_tpu.core.contracts.exceptions",
+    "corda_tpu.core.crypto.signatures",
+    "corda_tpu.core.crypto.merkle",
+    "corda_tpu.core.transactions.signed",
+    "corda_tpu.core.serialization.codec",
+    "corda_tpu.node.notary",
+)
+_ERROR_REGISTRY: dict[str, type] | None = None
+
+
+def _error_registry() -> dict[str, type]:
+    global _ERROR_REGISTRY
+    if _ERROR_REGISTRY is None:
+        import importlib
+
+        reg: dict[str, type] = {}
+        for mod_name in _ERROR_MODULES:
+            mod = importlib.import_module(mod_name)
+            for obj in vars(mod).values():
+                # defining module only — re-exports register under their
+                # home module, matching _error_payload's encoding
+                if (isinstance(obj, type) and issubclass(obj, Exception)
+                        and obj.__module__ == mod_name):
+                    reg[f"{mod_name}:{obj.__qualname__}"] = obj
+        for obj in (ValueError, KeyError, RuntimeError, TimeoutError):
+            reg[f"builtins:{obj.__qualname__}"] = obj
+        _ERROR_REGISTRY = reg
+    return _ERROR_REGISTRY
+
+
 def _rebuild_error(payload) -> Exception:
     if isinstance(payload, str):
         return FlowException(payload)
     type_path, msg = payload
+    cls = _error_registry().get(type_path)
+    if cls is None:
+        return FlowException(msg)
     try:
-        import importlib
-        mod_name, qualname = type_path.split(":", 1)
-        obj = importlib.import_module(mod_name)
-        for attr in qualname.split("."):
-            obj = getattr(obj, attr)
-        return obj(msg)
+        return cls(msg)
     except Exception:
         return FlowException(msg)
 

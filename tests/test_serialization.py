@@ -230,3 +230,68 @@ def test_plain_unknown_type_still_rejected():
     blob = codec._MAGIC + codec._packb(wire)
     with pytest.raises(SerializationError):
         codec.deserialize(blob)
+
+
+def test_carpenter_rejects_huge_field_count():
+    """ADVICE r4 (medium): a hostile peer must not be able to force
+    synthesis of an arbitrarily wide (then pinned-forever) class via one
+    schema'd object — field count is bounded like the name count."""
+    import msgpack
+
+    from corda_tpu.core.serialization import codec
+    names = [f"f{i}" for i in range(codec._CARPENTED_MAX_FIELDS + 1)]
+    with pytest.raises(SerializationError):
+        codec.carpented_class("evil.Wide", names)
+    # and via the wire (the hostile-peer path)
+    wire = msgpack.ExtType(
+        codec._EXT_OBJ_SCHEMA,
+        codec._packb(["evil.Wide2", names, [0] * len(names)]))
+    blob = codec._MAGIC + codec._packb(wire)
+    with pytest.raises(SerializationError):
+        codec.deserialize(blob)
+    # the boundary itself is fine
+    ok = codec.carpented_class(
+        "test.carpenter.ExactlyMax",
+        [f"f{i}" for i in range(codec._CARPENTED_MAX_FIELDS)])
+    codec._CARPENTED.pop("test.carpenter.ExactlyMax", None)
+    codec._CARPENTED_BY_CLASS.pop(ok, None)
+
+
+def test_schema_skew_binds_by_name_not_position():
+    """ADVICE r4 (low): when the real class IS registered, carried field
+    names from a peer with a different declaration ORDER must bind by
+    name; disjoint field sets must be a SerializationError, not a
+    positional misbind or raw TypeError."""
+    import dataclasses
+
+    import msgpack
+
+    from corda_tpu.core.serialization import codec
+
+    @dataclasses.dataclass(frozen=True)
+    class SkewState:
+        issuer: str
+        quantity: int
+
+    name = "test.skew.SkewState"
+    codec.register_type(name, SkewState, carry_schema=True)
+    try:
+        # peer serialized under a REVERSED declaration order
+        wire = msgpack.ExtType(
+            codec._EXT_OBJ_SCHEMA,
+            codec._packb([name, ["quantity", "issuer"], [42, "O=Issuer"]]))
+        blob = codec._MAGIC + codec._packb(wire)
+        got = codec.deserialize(blob)
+        assert got == SkewState(issuer="O=Issuer", quantity=42)
+
+        # disjoint field names: rejected, not positionally bound
+        wire = msgpack.ExtType(
+            codec._EXT_OBJ_SCHEMA,
+            codec._packb([name, ["issuer", "totally_else"], ["O=X", 1]]))
+        blob = codec._MAGIC + codec._packb(wire)
+        with pytest.raises(SerializationError):
+            codec.deserialize(blob)
+    finally:
+        codec._REGISTRY.pop(name, None)
+        codec._BY_CLASS.pop(SkewState, None)
+        codec._SCHEMA_NAMES.pop(name, None)

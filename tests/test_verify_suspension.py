@@ -219,3 +219,62 @@ def test_mesh_devices_requires_tpu_verifier():
     # and the valid combination constructs fine
     NodeConfiguration(my_legal_name="O=Good, L=London, C=GB",
                       verifier_type="Tpu", mesh_devices=4)
+
+
+class VerifyThenSleepFlow(FlowLogic):
+    """Parks on Verify, then parks AGAIN on a long Sleep — the second park
+    is the target a stale verify completion must not wrongly resume."""
+
+    def __init__(self, stx):
+        self.stx = stx
+
+    def call(self):
+        from corda_tpu.flows.api import Sleep
+        yield Verify(self.stx)
+        yield Sleep(3600)
+        return "woke"
+
+
+def test_stale_verify_completion_does_not_resume_wrong_park():
+    """ADVICE r4 (low): _on_verify_done must check the flow is still parked
+    on the ORIGINATING Verify request (like wake_timers' identity check) —
+    a duplicate/stale future completion after the flow moved on must not
+    resume it at the wrong yield."""
+    network, node = make_network_node()
+    svcs = seed_services(node)
+    manual = ManualVerifierService()
+    node.services.verifier_service = manual
+    fsm = node.start_flow(VerifyThenSleepFlow(make_issue_stx(svcs)))
+    verify_request = fsm.parked_on
+    assert isinstance(verify_request, Verify)
+    manual.futures[0].set_result(None)
+    network.run_network()        # verify resumes; flow re-parks on Sleep
+    assert not fsm.done
+    sleep_park = fsm.parked_on
+    assert sleep_park is not None and sleep_park is not verify_request
+
+    # a duplicate delivery of the SAME verify completion arrives late
+    node.smm._awaiting_external += 1   # pair the handler's decrement
+    node.smm._on_verify_done(fsm, manual.futures[0], verify_request)
+    assert fsm.parked_on is sleep_park and not fsm.done
+
+
+def test_rebuild_error_uses_whitelist_not_dynamic_import():
+    """ADVICE r4 (low): checkpoint error payloads must reconstruct only
+    whitelisted exception types — an arbitrary 'module:qualname' gadget
+    (import side effects, arbitrary one-string-arg callables) degrades to
+    FlowException instead of being imported and invoked."""
+    from corda_tpu.flows.api import FlowException, FlowTimeoutException
+    from corda_tpu.node.statemachine import _error_payload, _rebuild_error
+
+    e = _rebuild_error(_error_payload(SignatureException("bad sig")))
+    assert type(e) is SignatureException and str(e) == "bad sig"
+    e = _rebuild_error(_error_payload(FlowTimeoutException("slow peer")))
+    assert type(e) is FlowTimeoutException
+    # legacy string payloads still work
+    assert type(_rebuild_error("plain")) is FlowException
+
+    for gadget in (["os.path:join", "x"], ["subprocess:Popen", "sleep 9"],
+                   ["builtins:exec", "1+1"], ["no.such.module:X", "y"]):
+        rebuilt = _rebuild_error(gadget)
+        assert type(rebuilt) is FlowException, gadget
