@@ -1,17 +1,31 @@
-"""North-star benchmark: ECDSA-secp256k1 signature verifies/sec/chip.
+"""North-star benchmark: signature verifies/sec/chip, all device schemes.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+per-scheme keys.  The primary metric/value stays ECDSA-secp256k1 (the
+driver's tracked series); the same artifact now carries the Ed25519 (the
+reference's DEFAULT scheme, Crypto.kt:119,170) and secp256r1 kernel rates,
+the Ed25519 and mixed-scheme service rates, and the p50 latencies —
+VERDICT r4 asked that every scheme's number be driver-reproducible, not
+BASELINE.md prose.
 
-vs_baseline is measured against single-threaded host-CPU verification via the
-`cryptography` (OpenSSL) package — the stand-in for the reference's
-single-threaded JVM `Crypto.doVerify` replay (BASELINE.md config 1; OpenSSL
-is strictly faster than the JVM/BouncyCastle path, so this under-reports our
-advantage rather than inflating it).
+vs_baseline is measured against single-threaded host-CPU verification via
+the `cryptography` (OpenSSL) package — the stand-in for the reference's
+single-threaded JVM `Crypto.doVerify` replay (BASELINE.md config 1;
+OpenSSL is strictly faster than the JVM/BouncyCastle path, so this
+under-reports our advantage rather than inflating it).
+
+Env knobs:
+  CORDA_TPU_BENCH_N       batch size (default 32768; use 256 to smoke-test)
+  CORDA_TPU_BENCH_UNIQUE  1 → sign a fully-unique batch (no tiling) for the
+                          gather-locality A/B (VERDICT r4 weak #6); slow
+                          (pure-Python signing), meant for one-off runs
 """
 from __future__ import annotations
 
 import json
+import os
 import pathlib
+import statistics
 import time
 
 import numpy as np
@@ -24,23 +38,44 @@ jax.config.update("jax_compilation_cache_dir",
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from corda_tpu.core.crypto import ecmath
+from corda_tpu.ops import ed25519 as ed_ops
 from corda_tpu.ops import weierstrass as wc_ops
 
-BATCH = 32768  # throughput peaks near 32k (dispatch amortized; 64k regresses)
-UNIQUE = 512    # distinct signatures (host signing is pure Python; tile up)
+BATCH = int(os.environ.get("CORDA_TPU_BENCH_N", 32768))
+UNIQUE = BATCH if os.environ.get("CORDA_TPU_BENCH_UNIQUE") else 512
 REPS = 3
+SERVICE_RUNS = 3   # service numbers are medians of this many runs
+                   # (tunnel variance is ±20%; BASELINE.md methodology note)
 
 
-def make_items(n: int):
+def _tile(base, n):
+    return (base * (n // len(base) + 1))[:n]
+
+
+def make_items(n: int, curve=None):
+    """ECDSA items [(priv, pub, msg, r, s)]; UNIQUE distinct, tiled to n."""
+    curve = curve or ecmath.SECP256K1
     rng = np.random.default_rng(123)
     base = []
     for _ in range(min(n, UNIQUE)):
-        priv = int.from_bytes(rng.bytes(32), "little") % (ecmath.SECP256K1.n - 1) + 1
-        pub = ecmath.SECP256K1.mul(priv, ecmath.SECP256K1.g)
+        priv = int.from_bytes(rng.bytes(32), "little") % (curve.n - 1) + 1
+        pub = curve.mul(priv, curve.g)
         msg = rng.bytes(64)
-        r, s = ecmath.ecdsa_sign(ecmath.SECP256K1, priv, msg)
+        r, s = ecmath.ecdsa_sign(curve, priv, msg)
         base.append((priv, pub, msg, r, s))
-    return (base * (n // len(base) + 1))[:n]
+    return _tile(base, n)
+
+
+def make_ed_items(n: int):
+    """Ed25519 items [(pub32, sig64, msg)]."""
+    rng = np.random.default_rng(321)
+    base = []
+    for _ in range(min(n, UNIQUE)):
+        seed = rng.bytes(32)
+        pub = ecmath.ed25519_public_key(seed)
+        msg = rng.bytes(64)
+        base.append((pub, ecmath.ed25519_sign(seed, msg), msg))
+    return _tile(base, n)
 
 
 def host_baseline_rate(items) -> float:
@@ -63,89 +98,152 @@ def host_baseline_rate(items) -> float:
     return len(items) / dt
 
 
-def device_rate(items) -> float:
-    import functools
-    kitems = [(pub, msg, r, s) for _, pub, msg, r, s in items]
-    *args, pre = wc_ops.prepare_batch_hybrid_wide(
-        kitems, wc_ops.HYBRID_G_WINDOW)
-    assert pre.all()
-    fn = functools.partial(wc_ops._verify_kernel_hybrid_wide,
-                           g_w=wc_ops.HYBRID_G_WINDOW)
-    ok = np.asarray(fn(*args))  # compile + warm
+def _kernel_rate(prep_args, fn) -> float:
+    ok = np.asarray(fn(*prep_args))  # compile + warm
     assert bool(ok.all()), "benchmark signatures must all verify"
     t0 = time.perf_counter()
     for _ in range(REPS):
         # the host copy is a hard sync: async dispatch through the device
         # tunnel makes block_until_ready alone under-measure
-        ok = np.asarray(fn(*args))
+        ok = np.asarray(fn(*prep_args))
     dt = time.perf_counter() - t0
-    return len(items) * REPS / dt
+    return ok.shape[0] * REPS / dt
 
 
-def service_metrics(items):
-    """The SERVICE-path numbers (VERDICT r2 #1b/c): verifies/s through the
-    SignatureBatcher seam (host prep + device kernel + future resolution —
-    what a node actually gets), and p50 latency @ batch=1 (the host-crossover
-    path: a lone check must not pay the ~140 ms device dispatch floor)."""
+def device_rate(items) -> float:
+    import functools
+    kitems = [(pub, msg, r, s) for _, pub, msg, r, s in items]
+    *args, pre = wc_ops.prepare_batch_hybrid_wide(
+        kitems, wc_ops.HYBRID_G_WINDOW)
+    assert np.asarray(pre).all()
+    return _kernel_rate(args, functools.partial(
+        wc_ops._verify_kernel_hybrid_wide, g_w=wc_ops.HYBRID_G_WINDOW))
+
+
+def r1_device_rate(items) -> float:
+    import functools
+    kitems = [(pub, msg, r, s) for _, pub, msg, r, s in items]
+    *args, pre = wc_ops.prepare_batch_windowed_single(
+        ecmath.SECP256R1, kitems, wc_ops.R1_G_WINDOW)
+    assert np.asarray(pre).all()
+    return _kernel_rate(args, functools.partial(
+        wc_ops._verify_kernel_windowed_single, curve_name="secp256r1",
+        w=wc_ops.R1_G_WINDOW))
+
+
+def ed_device_rate(items) -> float:
+    import functools
+    *args, pre = ed_ops.prepare_batch_split(items, ed_ops.SPLIT_B_WINDOW)
+    assert np.asarray(pre).all()
+    return _kernel_rate(args, functools.partial(
+        ed_ops._verify_kernel_split, w=ed_ops.SPLIT_B_WINDOW))
+
+
+def _ecdsa_triples(items, curve, scheme):
     from corda_tpu.core.crypto.keys import PublicKey, sec1_compress
-    from corda_tpu.core.crypto.schemes import ECDSA_SECP256K1_SHA256
-    from corda_tpu.verifier.batcher import SignatureBatcher
+    return [(PublicKey(scheme, sec1_compress(curve, pub)),
+             ecmath.ecdsa_sig_to_der(r, s), msg)
+            for _, pub, msg, r, s in items]
 
-    triples = [(PublicKey(ECDSA_SECP256K1_SHA256,
-                          sec1_compress(ecmath.SECP256K1, pub)),
-                ecmath.ecdsa_sig_to_der(r, s), msg)
-               for _, pub, msg, r, s in items]
-    batcher = SignatureBatcher()
-    try:
-        assert all(batcher.submit_group(triples).result(timeout=600))  # warm
-        # continuous stream: all reps queued up front so the dispatcher's
-        # pipeline overlaps batch N+1's host prep with batch N's device
-        # round-trip (the service's steady-state shape)
+
+def _k1_triples(items):
+    from corda_tpu.core.crypto.schemes import ECDSA_SECP256K1_SHA256
+    return _ecdsa_triples(items, ecmath.SECP256K1, ECDSA_SECP256K1_SHA256)
+
+
+def _ed_triples(items):
+    from corda_tpu.core.crypto.keys import PublicKey
+    from corda_tpu.core.crypto.schemes import EDDSA_ED25519_SHA512
+    return [(PublicKey(EDDSA_ED25519_SHA512, pub), sig, msg)
+            for pub, sig, msg in items]
+
+
+def _service_rate_for(batcher, triples) -> float:
+    """Median continuous-stream rate over SERVICE_RUNS runs (all reps
+    queued up front so batch N+1's host prep overlaps batch N's device
+    round-trip — the service's steady-state shape)."""
+    assert all(batcher.submit_group(triples).result(timeout=900))   # warm
+    rates = []
+    for _ in range(SERVICE_RUNS):
         t0 = time.perf_counter()
         group_futures = [batcher.submit_group(triples) for _ in range(REPS)]
         for gf in group_futures:
             assert all(gf.result(timeout=600))
-        service_rate = len(triples) * REPS / (time.perf_counter() - t0)
+        rates.append(len(triples) * REPS / (time.perf_counter() - t0))
+    return statistics.median(rates)
+
+
+def service_metrics(k1_items, ed_items, r1_items):
+    """Service-path numbers through the SignatureBatcher seam (host prep +
+    device kernel + future resolution — what a node actually gets): k1,
+    ed25519, and a mixed-scheme stream; p50 @ batch=1 and @ batch=1k."""
+    from corda_tpu.core.crypto.schemes import ECDSA_SECP256R1_SHA256
+    from corda_tpu.verifier.batcher import SignatureBatcher
+
+    k1_triples = _k1_triples(k1_items)
+    ed_triples = _ed_triples(ed_items)
+    n = len(k1_triples)
+    # GeneratedLedger-style mix (BASELINE config 2 direction): the default
+    # scheme dominates, k1 heavy, r1 present (VerifierTests.kt:37-100 uses
+    # mixed generated ledgers as the verification corpus)
+    r1_triples = _ecdsa_triples(
+        r1_items[: max(1, n - 2 * int(0.45 * n))],
+        ecmath.SECP256R1, ECDSA_SECP256R1_SHA256)
+    mixed = (ed_triples[: int(0.45 * n)] + k1_triples[: int(0.45 * n)]
+             + r1_triples)
+    batcher = SignatureBatcher()
+    try:
+        k1_rate = _service_rate_for(batcher, k1_triples)
+        ed_rate = _service_rate_for(batcher, ed_triples)
+        mixed_rate = _service_rate_for(batcher, mixed)
         latencies = []
         for i in range(41):
-            key, der, msg = triples[i % len(triples)]
+            key, der, msg = k1_triples[i % len(k1_triples)]
             t0 = time.perf_counter()
             assert batcher.submit(key, der, msg).result(timeout=60)
             latencies.append(time.perf_counter() - t0)
         p50_ms = sorted(latencies)[len(latencies) // 2] * 1000.0
-        # mid-size-batch latency (VERDICT r3 weak #5): the band between the
-        # host crossover (192) and dispatch-floor amortization (~8k) pays
-        # the linger window plus the fixed device dispatch — report it so
-        # the worst-case latency region is visible, not just batch=1
-        # warm the 1k bucket first: its kernel compile must not pollute the
-        # latency sample (nor trip the sample timeout on a cold cache)
-        assert all(batcher.submit_group(triples[:1024]).result(timeout=900))
+        # mid-size-batch latency (VERDICT r3 weak #5 / r4 #7): the band
+        # between the host crossover (192) and dispatch-floor amortization
+        # (~8k) pays the linger window plus the fixed device dispatch.
+        # Warm the 1k bucket first so its compile doesn't pollute samples.
+        sub = k1_triples[:1024]
+        assert all(batcher.submit_group(sub).result(timeout=900))
         mid = []
         for _ in range(9):
             t0 = time.perf_counter()
-            assert all(batcher.submit_group(triples[:1024]).result(
-                timeout=120))
+            assert all(batcher.submit_group(sub).result(timeout=120))
             mid.append(time.perf_counter() - t0)
         p50_1k_ms = sorted(mid)[len(mid) // 2] * 1000.0
     finally:
         batcher.close()
-    return service_rate, p50_ms, p50_1k_ms
+    return k1_rate, ed_rate, mixed_rate, p50_ms, p50_1k_ms
 
 
 def main() -> None:
     items = make_items(BATCH)
+    ed_items = make_ed_items(BATCH)
+    r1_items = make_items(BATCH, ecmath.SECP256R1)
     dev = device_rate(items)
-    service_rate, p50_ms, p50_1k_ms = service_metrics(items)
+    ed_dev = ed_device_rate(ed_items)
+    r1_dev = r1_device_rate(r1_items)
+    k1_rate, ed_rate, mixed_rate, p50_ms, p50_1k_ms = service_metrics(
+        items, ed_items, r1_items)
     host = host_baseline_rate(items[: min(128, BATCH)])
     print(json.dumps({
         "metric": "ecdsa_secp256k1_verifies_per_sec_per_chip",
         "value": round(dev, 1),
         "unit": "verifies/s",
         "vs_baseline": round(dev / host, 3),
-        "service_path_verifies_per_sec": round(service_rate, 1),
+        "ed25519_verifies_per_sec_per_chip": round(ed_dev, 1),
+        "secp256r1_verifies_per_sec_per_chip": round(r1_dev, 1),
+        "service_path_verifies_per_sec": round(k1_rate, 1),
+        "ed25519_service_path_verifies_per_sec": round(ed_rate, 1),
+        "mixed_service_path_verifies_per_sec": round(mixed_rate, 1),
         "tx_verify_p50_ms_batch1": round(p50_ms, 3),
         "tx_verify_p50_ms_batch1k": round(p50_1k_ms, 3),
         "host_baseline_verifies_per_sec": round(host, 1),
+        "unique_signatures": UNIQUE,
     }))
 
 

@@ -127,25 +127,50 @@ def carpented_class(name: str, field_names: list[str]) -> type:
     a schema'd wire object whose real class is absent — the runtime class
     synthesis of the reference's ClassCarpenter, minus bytecode: the bag is
     inert data (no methods), so the deserialization whitelist's gadget
-    protection is preserved. Carpented instances re-serialize bit-exactly
-    under the original name with the carried schema (round-trip safe).
-    Every hostile-input failure mode is a SerializationError."""
-    import keyword
+    protection is preserved.
 
+    SCHEMA EVOLUTION: a second schema under the same name carpents the
+    UNION of all fields seen so far (stable order: first-seen first) and
+    becomes the name's class for subsequent decodes — every field defaults
+    to None, so a wire form carrying any subset still materializes
+    (reference evolution direction: ClassCarpenter.kt:30-447 +
+    amqp/SerializerFactory.kt).  Each carpented CLASS remembers its own
+    schema (``__corda_carpented_fields__``): instances re-serialize under
+    the schema they were built with — a bag decoded before an evolution
+    stays bit-exact on re-serialization; a union bag re-serializes under
+    the union schema.  Unions grow monotonically and the per-schema field
+    cap bounds them, so a hostile peer cannot mint unbounded classes for
+    one name.  Every hostile-input failure mode is a SerializationError."""
     entry = _CARPENTED.get(name)
     if entry is not None:
         cls, known = entry
-        if known != list(field_names):
-            raise SerializationError(
-                f"Conflicting carpented schemas for {name!r}: "
-                f"{known} vs {list(field_names)}")
-        return cls
+        if known == list(field_names):
+            return cls
+        union = list(known) + [fn for fn in field_names if fn not in known]
+        if union == known:        # subset of what we already know
+            return cls
+        return _carpent(name, union)
+    return _carpent(name, list(field_names))
+
+
+#: Total class syntheses (first carpents AND union evolutions): every
+#: synthesized class is pinned for the process lifetime, so the budget
+#: must count evolutions too — otherwise a hostile peer could stream
+#: one-field-at-a-time schema changes and mint ~256 classes per name
+#: beyond the name cap.
+_carpent_count = 0
+
+
+def _carpent(name: str, field_names: list[str]) -> type:
+    import keyword
+
+    global _carpent_count
+    if _carpent_count >= _CARPENTED_MAX:
+        raise SerializationError(
+            f"Carpented-class budget ({_CARPENTED_MAX}) exhausted; "
+            f"refusing to synthesize {name!r}")
     if not isinstance(name, str) or not name:
         raise SerializationError(f"Bad carpented type name {name!r}")
-    if len(_CARPENTED) >= _CARPENTED_MAX:
-        raise SerializationError(
-            f"Carpented-type limit ({_CARPENTED_MAX}) reached; "
-            f"refusing to synthesize {name!r}")
     if len(field_names) > _CARPENTED_MAX_FIELDS:
         raise SerializationError(
             f"Carpented schema for {name!r} has {len(field_names)} fields "
@@ -160,13 +185,17 @@ def carpented_class(name: str, field_names: list[str]) -> type:
     try:
         cls = dataclasses.make_dataclass(
             name.rsplit(".", 1)[-1] or "Carpented",
-            [(fn, Any) for fn in field_names], frozen=True, eq=True)
+            [(fn, Any, dataclasses.field(default=None))
+             for fn in field_names],
+            frozen=True, eq=True)
     except (TypeError, ValueError) as e:
         raise SerializationError(
             f"Cannot carpent {name!r}: {e}") from e
     cls.__corda_carpented__ = name
+    cls.__corda_carpented_fields__ = list(field_names)
     _CARPENTED[name] = (cls, list(field_names))
     _CARPENTED_BY_CLASS[cls] = name
+    _carpent_count += 1
     return cls
 
 
@@ -233,8 +262,11 @@ def to_wire(obj: Any) -> Any:
     name = _BY_CLASS.get(type(obj))
     if name is None:
         cname = _CARPENTED_BY_CLASS.get(type(obj))
-        if cname is not None:      # carpented bag: round-trips bit-exactly
-            _, field_names = _CARPENTED[cname]
+        if cname is not None:
+            # carpented bag: re-serializes under ITS OWN schema (the one
+            # its class was built with), so pre-evolution instances stay
+            # bit-exact and union bags emit the union schema
+            field_names = type(obj).__corda_carpented_fields__
             fields = [to_wire(getattr(obj, fn)) for fn in field_names]
             return msgpack.ExtType(_EXT_OBJ_SCHEMA,
                                    _packb([cname, field_names, fields]))
@@ -307,6 +339,18 @@ def from_wire(wire: Any) -> Any:
                     if sorted(field_names) == sorted(local):
                         by_name = dict(zip(field_names, fields))
                         fields = [by_name[n] for n in local]
+                    elif name in _SCHEMA_NAMES:
+                        # SCHEMA EVOLUTION (reference ClassCarpenter.kt +
+                        # amqp/SerializerFactory.kt evolution direction):
+                        # a peer on another VERSION of the type — fields
+                        # it doesn't carry fill from local dataclass
+                        # defaults; fields the local version dropped are
+                        # ignored. Only carry_schema types qualify (their
+                        # codec is the default dataclass one, so binding
+                        # by declaration order is sound); no default for
+                        # a missing field ⇒ genuinely incompatible.
+                        return _evolved_decode(name, cls, local,
+                                               field_names, fields)
                     else:
                         raise SerializationError(
                             f"Schema'd object {name!r}: carried fields "
@@ -319,7 +363,8 @@ def from_wire(wire: Any) -> Any:
                         f"Schema'd object {name!r} does not fit local "
                         f"class: {e}") from e
             cls = carpented_class(name, field_names)
-            return cls(*[_freeze(from_wire(f)) for f in fields])
+            return cls(**{fn: _freeze(from_wire(f))
+                          for fn, f in zip(field_names, fields)})
         raise SerializationError(f"Unknown ext code {code}")
     if isinstance(wire, (list, tuple)):
         return [from_wire(x) for x in wire]
@@ -328,6 +373,36 @@ def from_wire(wire: Any) -> Any:
 
 def _freeze(v):
     return tuple(v) if isinstance(v, list) else v
+
+
+def _evolved_decode(name: str, cls, local: list[str], field_names, fields):
+    """Decode a schema'd object whose carried field set differs from the
+    local version of the class: carried-and-local fields bind by name,
+    locally-ADDED fields take the dataclass default (the v1→v2 direction),
+    carried-but-REMOVED fields are dropped (v2→v1).  A locally-added field
+    WITHOUT a default is a genuine incompatibility and fails typed."""
+    by_name = {fn: from_wire(v) for fn, v in zip(field_names, fields)}
+    spec = {f.name: f for f in dataclasses.fields(cls)}
+    vals = []
+    for n in local:
+        if n in by_name:
+            vals.append(_freeze(by_name[n]))
+            continue
+        f = spec[n]
+        if f.default is not dataclasses.MISSING:
+            vals.append(f.default)
+        elif f.default_factory is not dataclasses.MISSING:
+            vals.append(f.default_factory())
+        else:
+            raise SerializationError(
+                f"Schema'd object {name!r}: peer version lacks field "
+                f"{n!r} and the local class declares no default for it")
+    try:
+        return cls(*vals)
+    except TypeError as e:
+        raise SerializationError(
+            f"Schema'd object {name!r} does not fit local class: {e}"
+        ) from e
 
 
 # ---------------------------------------------------------------------------

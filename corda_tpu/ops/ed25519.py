@@ -124,23 +124,36 @@ def shamir_ladder(bits1, bits2, P1, P2):
 #: Niels table. 256 = 16x16 divides exactly; the table is ~6MB of u16.
 B_WINDOW = 16
 
-_B_TABLES: dict[int, tuple] = {}
+_B_TABLES: dict[tuple, tuple] = {}
 
 
-def _b_window_table(w: int):
-    """(2^w, NLIMB) u16 arrays (y+x, y−x, 2d·x·y) of wa·B — the Niels/Duif
-    precomputed form the mixed add consumes. Row 0 (the identity) is
-    naturally (1, 1, 0): valid input to the mixed add, NO flag machinery
-    (unlike the Weierstrass table's Z=0 rows). Built host-side with one
-    Montgomery batch inversion for all the affine-add denominators."""
-    if w in _B_TABLES:
-        return _B_TABLES[w]
+def _shift_base(k: int):
+    """[2^k]B as an affine point (host chain, one-time per process)."""
+    ext = ecmath.ed_to_extended(ecmath.ED_B)
+    for _ in range(k):
+        ext = ecmath.ed_point_double(ext)
+    zi = pow(ext[2], P - 2, P)
+    return (ext[0] * zi % P, ext[1] * zi % P)
+
+
+def _b_window_table(w: int, shift: int = 0):
+    """(2^w, NLIMB) u16 arrays (y+x, y−x, 2d·x·y) of wa·[2^shift]B — the
+    Niels/Duif precomputed form the mixed add consumes. Row 0 (the
+    identity) is naturally (1, 1, 0): valid input to the mixed add, NO
+    flag machinery (unlike the Weierstrass table's Z=0 rows). Built
+    host-side with one Montgomery batch inversion for all the affine-add
+    denominators. ``shift=128`` builds the split-k ladder's second
+    constant-base table ([2^128]B — see split_ladder)."""
+    key = (w, shift)
+    if key in _B_TABLES:
+        return _B_TABLES[key]
     span = 1 << w
-    # chain wa·B in EXTENDED coordinates (no inversion per add), then one
+    # chain wa·base in EXTENDED coordinates (no inversion per add), then one
     # Montgomery batch inversion of every Z to land affine
     from .weierstrass import _batch_modinv
+    base = ecmath.ED_B if shift == 0 else _shift_base(shift)
     ext = [None] * span
-    ext[1] = ecmath.ed_to_extended(ecmath.ED_B)
+    ext[1] = ecmath.ed_to_extended(base)
     for wa in range(2, span):
         ext[wa] = ecmath.ed_point_add(ext[wa - 1], ext[1])
     zinvs = iter(_batch_modinv([e[2] for e in ext[1:]], P))
@@ -153,14 +166,15 @@ def _b_window_table(w: int):
         ms.append((y - x) % P)
         tds.append(ecmath.ED_D2 * x % P * y % P)
     tab = tuple(F.to_limbs(v).astype(np.uint16) for v in (ps, ms, tds))
-    _B_TABLES[w] = tab
+    _B_TABLES[key] = tab
     return tab
 
 
-def b_table_device(w: int = B_WINDOW):
+def b_table_device(w: int = B_WINDOW, shift: int = 0):
     """The Niels base table as committed device arrays (kernel ARGUMENTS,
     not baked constants — see weierstrass.g_window_table_device)."""
-    return F.device_table_cache(("niels_b", w), lambda: _b_window_table(w))
+    return F.device_table_cache(("niels_b", w, shift),
+                                lambda: _b_window_table(w, shift))
 
 
 def madd_niels(Pt, tab_p, tab_m, tab_td):
@@ -248,6 +262,117 @@ _verify_kernel_windowed = jax.jit(verify_core_windowed,
                                   static_argnames=("w",))
 
 
+# ---------------------------------------------------------------------------
+# Split-k windowed ladder: both scalars split at bit 128, HALVING the
+# doublings (the dominant ladder cost) — the ed25519 analog of secp256k1's
+# GLV shape (edwards25519 has no endomorphism, but [k]A = [k_lo]A +
+# [k_hi]([2^128]A) needs only a per-SIGNER precomputation of [2^128]A,
+# cached host-side like the decompression):
+#   [s]B + [k](−A) = [s_lo]B + [s_hi]B' + [k_lo](−A) + [k_hi](−A')
+# with B' = [2^128]B (a CONSTANT → second Niels table) and A' = [2^128]A.
+# Ladder: 128 doubles + 64 joint (k_lo, k_hi) table adds + 8 + 8 mixed
+# B/B' adds + a 13-op joint-table build, vs the plain windowed ladder's
+# 256 doubles + 128 A adds + 16 B adds — measured on v5e (BASELINE.md r5).
+# ---------------------------------------------------------------------------
+
+def _joint_a_table(neg_a, neg_a2):
+    """16-entry per-item table T[i + 4j] = [i](−A) + [j](−A') (i, j ∈ [0,4))
+    from AFFINE (x, y, t) triples (z = 1 implied): 2 doubles + 11 unified
+    adds, one-time per batch — the Edwards sibling of the k1 Q window table
+    (weierstrass._q_window_table)."""
+    ax, ay, at = neg_a
+    a2x, a2y, a2t = neg_a2
+    one = F.one_like(ax)
+    batch_shape = ax.shape[:-1]
+    T = [identity(batch_shape)] * 16
+    T[1] = (ax, ay, one, at)
+    T[2] = double(T[1])
+    T[3] = add(T[2], T[1])
+    T[4] = (a2x, a2y, one, a2t)
+    T[8] = double(T[4])
+    T[12] = add(T[8], T[4])
+    for j in (4, 8, 12):
+        T[j + 1] = add(T[j], T[1])
+        T[j + 2] = add(T[j + 1], T[1])
+        T[j + 3] = add(T[j + 2], T[1])
+    return T
+
+
+def split_ladder(b_idx, b2_idx, a_packed, neg_a, neg_a2, btab, b2tab,
+                 w: int):
+    """[s_lo]B + [s_hi]B' + [k_lo](−A) + [k_hi](−A') over 128 bits.
+
+    ``b_idx``/``b2_idx``: (128/w, B) Niels-table indices for the two
+    constant bases; ``a_packed``: (128/w, w/2, B) packed 2-bit joint digits
+    (k_lo | k_hi<<2); ``neg_a``/``neg_a2``: affine (x, y, t) limb triples;
+    ``btab``/``b2tab``: the (2^w, NLIMB) Niels arrays for B and [2^128]B."""
+    table = _joint_a_table(neg_a, neg_a2)
+    tab_p, tab_m, tab_td = btab
+    tab2_p, tab2_m, tab2_td = b2tab
+
+    def joint_addend(qb):
+        """qb: (B,) packed joint digit klo | khi<<2 — 16-way select tree
+        (same fold-by-bit shape as the k1 hybrid ladder's q_addend)."""
+        level = table
+        for j in range(4):
+            b = ((qb >> j) & 1).astype(jnp.bool_)
+            level = [tuple(F.select(b, hi_c, lo_c)
+                           for lo_c, hi_c in zip(lo, hi))
+                     for lo, hi in zip(level[0::2], level[1::2])]
+        return level[0]
+
+    def b_adds(acc, bi, b2i):
+        acc = madd_niels(acc, tab_p[bi].astype(jnp.uint64),
+                         tab_m[bi].astype(jnp.uint64),
+                         tab_td[bi].astype(jnp.uint64))
+        return madd_niels(acc, tab2_p[b2i].astype(jnp.uint64),
+                          tab2_m[b2i].astype(jnp.uint64),
+                          tab2_td[b2i].astype(jnp.uint64))
+
+    def a_step(acc, qb):
+        acc = double(double(acc))
+        return add(acc, joint_addend(qb)), None
+
+    def step(acc, ins):
+        bi, b2i, qbs = ins
+        acc, _ = jax.lax.scan(a_step, acc, qbs)
+        return b_adds(acc, bi, b2i), None
+
+    # peel step 0: the accumulator is the identity, so the leading
+    # double-double-add collapses to selecting the first joint addend
+    acc = joint_addend(a_packed[0][0])
+    acc, _ = jax.lax.scan(a_step, acc, a_packed[0][1:])
+    acc = b_adds(acc, b_idx[0], b2_idx[0])
+    acc, _ = jax.lax.scan(step, acc, (b_idx[1:], b2_idx[1:], a_packed[1:]))
+    return acc
+
+
+def verify_core_split(b_idx, b2_idx, a_packed, neg_a, neg_a2, r_y, r_sign,
+                      tab_p, tab_m, tab_td, tab2_p, tab2_m, tab2_td,
+                      w: int):
+    """Split-k verify: RFC 8032 re-encoding acceptance (see
+    verify_core_windowed) over the half-length ladder."""
+    b_idx = jnp.asarray(b_idx, jnp.int32)
+    b2_idx = jnp.asarray(b2_idx, jnp.int32)
+    a_packed = jnp.asarray(a_packed, jnp.uint64)
+    neg_a = tuple(jnp.asarray(c, jnp.uint64) for c in neg_a)
+    neg_a2 = tuple(jnp.asarray(c, jnp.uint64) for c in neg_a2)
+    r_y = jnp.asarray(r_y, jnp.uint64)
+    r_sign = jnp.asarray(r_sign)
+    acc = split_ladder(b_idx, b2_idx, a_packed, neg_a, neg_a2,
+                       (tab_p, tab_m, tab_td), (tab2_p, tab2_m, tab2_td), w)
+    x, y, z, _ = acc
+    zi = F.inv(z, P)
+    x_aff = F.canon(F.mul(x, zi, P), P)
+    y_aff = F.canon(F.mul(y, zi, P), P)
+    ok_y = jnp.all(y_aff == r_y, axis=-1)
+    ok_sign = (x_aff[..., 0] & 1) == r_sign
+    return ok_y & ok_sign
+
+
+_verify_kernel_split = jax.jit(verify_core_split, static_argnames=("w",))
+
+
 def verify_core(s_bits, k_bits, neg_a, r_affine):
     """Device core: ok[i] = ([s]B + [k](-A) == R) per batch item.
 
@@ -292,6 +417,39 @@ def _decompress_a(pub: bytes):
     is ~2 modpows of host bigint work per call, and a node verifies the
     same signers' keys over and over (the service path is host-CPU-bound)."""
     return ecmath.ed_point_decompress(pub)
+
+
+def _row_from_affine(A) -> np.ndarray:
+    """Affine A → the split kernel's packed per-signer row: (−A, −A') as
+    two affine (x, y, t) limb triples in one (6, 16) u16 array, where
+    A' = [2^128]A (128 host doublings + one inversion — per NEW signer
+    only; see _signer_row)."""
+    x, y = A
+    ext = ecmath.ed_to_extended(A)
+    for _ in range(128):
+        ext = ecmath.ed_point_double(ext)
+    zi = pow(ext[2], P - 2, P)
+    x2, y2 = ext[0] * zi % P, ext[1] * zi % P
+    nx, nx2 = (P - x) % P, (P - x2) % P
+    vals = [nx, y, nx * y % P, nx2, y2, nx2 * y2 % P]
+    return F.to_limbs(vals).astype(np.uint16)
+
+
+@functools.lru_cache(maxsize=65536)
+def _signer_row(pub: bytes):
+    """Per-signer cache of the split kernel's (−A, −A') limb row (None for
+    an invalid key). The [2^128]A precomputation rides the same
+    signers-repeat locality as _decompress_a; a cold signer costs ~0.5ms of
+    host bigints ONCE, then every batch containing it is a numpy row copy."""
+    A = _decompress_a(pub)
+    return None if A is None else _row_from_affine(A)
+
+
+@functools.lru_cache(maxsize=1)
+def _substitute_row() -> np.ndarray:
+    """Row substituted for structurally-invalid items (base point, matching
+    the plain path's A := ED_B substitution; verdict masked by precheck)."""
+    return _row_from_affine(ecmath.ED_B)
 
 
 def _precheck_items(items, decompress_r: bool):
@@ -364,20 +522,115 @@ def prepare_batch_windowed(items: list[tuple[bytes, bytes, bytes]],
     before precheck so ``*args, precheck`` callers pass straight through).
     Mesh callers pass ``device_tables=False`` and supply their own
     replicated table copies instead (no stranded single-device upload)."""
+    from . import scalarprep as sp
     from .weierstrass import _bits_to_w_windows, _bits_to_windows
     precheck, a_pts, _, r_ys, r_signs, ss, ks = _precheck_items(
         items, decompress_r=False)
     neg_a = _pack_point_ext([(P - x, y) for x, y in a_pts])
     r_y = jnp.asarray(F.to_limbs(r_ys).astype(np.uint16))
     r_sign = jnp.asarray(np.asarray(r_signs, dtype=np.uint8))
-    b_idx = _bits_to_w_windows(F.scalars_to_bits(ss), w).astype(np.int32)
-    digs = _bits_to_windows(F.scalars_to_bits(ks)).astype(np.uint8)
-    a_digits = digs.reshape(256 // w, w // 2, *digs.shape[1:])
+    if w == 16 and sp.available():
+        # native window extraction (h is not retained by _precheck_items,
+        # so feed the already-derived k scalars as 256-bit "digests")
+        h_words = np.zeros((len(items), 8), dtype=np.uint64)
+        h_words[:, :4] = sp.ints_to_words(ks)
+        b_idx, a_digits_flat, _ = sp.ed_prep_plain(
+            h_words, sp.ints_to_words(ss))
+        a_digits = a_digits_flat.reshape(256 // w, w // 2, len(items))
+    else:
+        b_idx = _bits_to_w_windows(F.scalars_to_bits(ss), w).astype(
+            np.int32)
+        digs = _bits_to_windows(F.scalars_to_bits(ks)).astype(np.uint8)
+        a_digits = digs.reshape(256 // w, w // 2, *digs.shape[1:])
     head = (jnp.asarray(b_idx), jnp.asarray(a_digits), neg_a, r_y, r_sign)
     if device_tables:
         return (*head, *b_table_device(w), precheck)
     return (*head, precheck)
 
+
+
+#: Constant-base window width for the split-k ladder (128 = 8x16 divides
+#: exactly: 8 outer steps of 16 doubles + 8 joint A adds + 1 B + 1 B' add).
+SPLIT_B_WINDOW = 16
+
+
+def prepare_batch_split(items: list[tuple[bytes, bytes, bytes]],
+                        w: int = SPLIT_B_WINDOW, device_tables: bool = True):
+    """Host prep for the split-k kernel: signatures parsed by numpy (the
+    wire bytes ARE little-endian u16 limbs), per-signer (−A, −A') rows from
+    the _signer_row cache, SHA-512 challenges via hashlib, and the scalar
+    windows from native scalarmath (Python-bigint fallback below).
+
+    Returns (b_idx, b2_idx, a_packed, neg_a, neg_a2, r_y, r_sign,
+    [tables...], precheck)."""
+    from . import scalarprep as sp
+    assert w == 16, "split prep emits 16-bit constant-base windows"
+    n = len(items)
+    rows = np.empty((n, 6, F.NLIMB), dtype=np.uint16)
+    precheck = np.ones(n, dtype=bool)
+    sig_mat = np.zeros((n, 64), dtype=np.uint8)
+    digests: list[bytes] = []
+    sub = _substitute_row()
+    for i, (pub, sig, msg) in enumerate(items):
+        row = _signer_row(bytes(pub)) if len(sig) == 64 else None
+        if row is None:
+            precheck[i] = False
+            rows[i] = sub
+            digests.append(bytes(64))   # k := 0 (verdict is masked anyway)
+        else:
+            rows[i] = row
+            sig_mat[i] = np.frombuffer(sig, dtype=np.uint8)
+            digests.append(hashlib.sha512(sig[:32] + pub + msg).digest())
+    r_limbs = sig_mat[:, :32].copy().view("<u2")        # (n, 16) wire y
+    r_sign = (r_limbs[:, 15] >> 15).astype(np.uint8)
+    r_y = r_limbs.copy()
+    r_y[:, 15] &= 0x7FFF
+    # non-canonical y (>= p = 2^255-19) rejects like a failed decompression
+    ge_p = ((r_y[:, 0] >= 0xFFED) & (r_y[:, 15] == 0x7FFF)
+            & (r_y[:, 1:15] == 0xFFFF).all(axis=1))
+    precheck &= ~ge_p
+    s_words = sig_mat[:, 32:].copy().view("<u8")        # (n, 4)
+    if sp.available():
+        h_words = sp.le_digests_to_words(digests, 8)
+        b_idx, b2_idx, a_packed, s_ok = sp.ed_prep(h_words, s_words)
+    else:
+        b_idx, b2_idx, a_packed, s_ok = _split_windows_python(
+            digests, s_words)
+    precheck &= s_ok
+    a_digits = a_packed.reshape(128 // w, w // 2, n)
+    head = (jnp.asarray(b_idx), jnp.asarray(b2_idx), jnp.asarray(a_digits),
+            tuple(jnp.asarray(np.ascontiguousarray(rows[:, j]))
+                  for j in range(3)),
+            tuple(jnp.asarray(np.ascontiguousarray(rows[:, 3 + j]))
+                  for j in range(3)),
+            jnp.asarray(r_y), jnp.asarray(r_sign))
+    if device_tables:
+        return (*head, *b_table_device(w, 0), *b_table_device(w, 128),
+                precheck)
+    return (*head, precheck)
+
+
+def _split_windows_python(digests: list[bytes], s_words: np.ndarray):
+    """Pure-Python fallback of scalarprep.ed_prep (bit-identical; used when
+    libscalarmath.so is absent — locked by tests/test_scalarprep.py)."""
+    from .weierstrass import _bits_to_w_windows, _bits_to_windows
+    n = len(digests)
+    mask128 = (1 << 128) - 1
+    s_ints = [int.from_bytes(s_words[i].tobytes(), "little")
+              for i in range(n)]
+    s_ok = np.array([s < ecmath.ED_L for s in s_ints], dtype=bool)
+    ss = [s if ok else 0 for s, ok in zip(s_ints, s_ok)]
+    ks = [int.from_bytes(d, "little") % ecmath.ED_L if ok else 0
+          for d, ok in zip(digests, s_ok)]
+    b_idx = _bits_to_w_windows(
+        F.scalars_to_bits([s & mask128 for s in ss], 128), 16).astype(
+            np.int32)
+    b2_idx = _bits_to_w_windows(
+        F.scalars_to_bits([s >> 128 for s in ss], 128), 16).astype(np.int32)
+    klo = _bits_to_windows(F.scalars_to_bits([k & mask128 for k in ks], 128))
+    khi = _bits_to_windows(F.scalars_to_bits([k >> 128 for k in ks], 128))
+    a_packed = (klo | (khi << 2)).astype(np.uint8)
+    return b_idx, b2_idx, a_packed, s_ok
 
 
 def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
@@ -394,13 +647,14 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
 def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
     """Dispatch without forcing (see weierstrass.verify_batch_async): the
     device computes while the caller preps the next batch. Rides the
-    windowed constant-B kernel — the fastest measured path."""
+    split-k half-length ladder — the fastest measured path (BASELINE.md
+    round 5)."""
     n = len(items)
     if n == 0:
         return (None, np.zeros(0, dtype=bool), 0)
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
-    *args, precheck = prepare_batch_windowed(padded, B_WINDOW)
-    return (_verify_kernel_windowed(*args, w=B_WINDOW), precheck, n)
+    *args, precheck = prepare_batch_split(padded, SPLIT_B_WINDOW)
+    return (_verify_kernel_split(*args, w=SPLIT_B_WINDOW), precheck, n)
 
 
 def finish_batch(pending) -> np.ndarray:

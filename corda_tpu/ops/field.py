@@ -150,6 +150,62 @@ def _fold_once(v, bounds, c_limbs):
     return _trim(acc, nb)
 
 
+def _fold_bounds_r1(bounds):
+    """Exact post-fold bounds of the SIGNED Solinas fold for P-256 (see
+    _fold_once_r1), or None when a column would overflow u64."""
+    lob, hib = bounds[:NLIMB], bounds[NLIMB:]
+    nh = len(hib)
+    neg = [0] * (12 + nh)
+    for i, b in enumerate(hib):
+        neg[6 + i] += b
+        neg[12 + i] += b
+    if max(neg) >= (1 << 63):
+        return None
+    off, ob = _dominator_offset(tuple(neg), PSECR1)
+    width = max(NLIMB, 14 + nh, len(ob))
+    nb = [0] * width
+    for i, b in enumerate(lob):
+        nb[i] += b
+    for i, b in enumerate(hib):
+        nb[i] += b
+        nb[14 + i] += b
+    for i, b in enumerate(ob):
+        nb[i] += b
+    return nb if max(nb) < (1 << 63) else None
+
+
+def _fold_once_r1(v, bounds):
+    """Signed Solinas fold for p = 2^256 - 2^224 + 2^192 + 2^96 - 1:
+    hi·2^256 ≡ hi·2^224 - hi·2^192 - hi·2^96 + hi, i.e. pure LIMB-SHIFTED
+    adds/subs (224/192/96 are multiples of 16) made borrow-free by a
+    dominator multiple of p — 4 shifted DUS ops instead of the generic
+    multiply-fold's ~14 per-limb multiply-adds (c = 2^256 mod p has 14
+    nonzero limbs, which also made the generic fold's bounds blow up so it
+    was rarely even ELIGIBLE, forcing extra carry passes first; this fold's
+    bounds grow additively, so it runs far earlier).  The r5 lever named in
+    BASELINE.md's round-4 r1 section."""
+    if v.dtype != jnp.uint64:
+        v = v.astype(jnp.uint64)
+    lo = v[..., :NLIMB]
+    hi, hib = v[..., NLIMB:], bounds[NLIMB:]
+    nh = len(hib)
+    nb = _fold_bounds_r1(bounds)
+    assert nb is not None, "u64 column overflow in r1 Solinas fold"
+    neg = [0] * (12 + nh)
+    for i, b in enumerate(hib):
+        neg[6 + i] += b
+        neg[12 + i] += b
+    off, _ = _dominator_offset(tuple(neg), PSECR1)
+    acc = jnp.zeros(v.shape[:-1] + (len(nb),), dtype=jnp.uint64)
+    acc = acc.at[..., :NLIMB].add(lo)
+    acc = acc.at[..., :nh].add(hi)
+    acc = acc.at[..., 14:14 + nh].add(hi)
+    acc = acc.at[..., :len(off)].add(jnp.asarray(off))
+    acc = acc.at[..., 6:6 + nh].add(-hi)
+    acc = acc.at[..., 12:12 + nh].add(-hi)
+    return _trim(acc, nb)
+
+
 def _normalize(v, bounds, p: int):
     """Carry/fold until the element meets the 16-limb contract. All control
     flow is host-side over exact bounds; terminates because folds strictly
@@ -160,8 +216,11 @@ def _normalize(v, bounds, p: int):
     instead of after carrying every limb below LMAX first: an early fold
     shrinks the array from up-to-31 limbs to ~16, so the remaining carry
     passes run at half the width (measured 4 passes + 2 folds per norm
-    before; the wide passes dominated the walk cost)."""
+    before; the wide passes dominated the walk cost).  P-256 routes through
+    the signed Solinas fold (_fold_once_r1) instead of the generic
+    multiply-fold."""
     c_limbs = _c_limbs_of(p)
+    solinas = p == PSECR1
     for _ in range(64):
         if len(bounds) > NLIMB:
             if (len(bounds) == NLIMB + 1
@@ -171,9 +230,11 @@ def _normalize(v, bounds, p: int):
                 v = v[..., :NLIMB].at[..., 15].set(merged)
                 bounds = bounds[:15] + [bounds[15] + (bounds[16] << LIMB_BITS)]
                 continue
-            nb = _fold_bounds(bounds, c_limbs)
+            nb = (_fold_bounds_r1(bounds) if solinas
+                  else _fold_bounds(bounds, c_limbs))
             if nb is not None:
-                v, bounds = _fold_once(v, bounds, c_limbs)
+                v, bounds = (_fold_once_r1(v, bounds) if solinas
+                             else _fold_once(v, bounds, c_limbs))
             else:
                 v, bounds = _pass(v, bounds)
             continue
@@ -399,7 +460,10 @@ def _dominator_offset(need: tuple, p: int):
     if key in _DOM_OFFSETS:
         return _DOM_OFFSETS[key]
     S = sum(int(b) << (LIMB_BITS * i) for i, b in enumerate(need))
-    M = (S // p) + 2
+    # M = S//p + 1 keeps R = M·p - S in (0, p] — a 16-limb offset. (+2 made
+    # R up to 2p ~ 2^257, whose 17th limb livelocked the r1 Solinas fold:
+    # a 17-limb value folded to ... a 17-limb value, forever.)
+    M = (S // p) + 1
     R = M * p - S
     width = max(len(need), -(-R.bit_length() // LIMB_BITS))
     digits = [int(b) for b in list(need) + [0] * (width - len(need))]

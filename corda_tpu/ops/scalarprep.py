@@ -1,0 +1,219 @@
+"""ctypes binding for native/libscalarmath.so — batch host-side scalar prep.
+
+The C library (native/scalarmath.cpp) performs the per-item scalar layer of
+signature verification (Barrett mulmod, Montgomery batch inversion, GLV
+decomposition, window/digit extraction, u16 limb packing) in one pass per
+batch; the Python bigint loops it replaces were the service path's ceiling
+(BASELINE.md round-4 close-out: ~0.9s per 32k secp256k1 batch, ~1.9s
+Ed25519).  Callers (ops/weierstrass.py, ops/ed25519.py) fall back to the
+original Python prep when the library is absent — behavior is identical
+(locked by tests/test_scalarprep.py differential tests).
+
+Word convention: multiword integers are little-endian u64 arrays; a
+256-bit value is a (4,) row, reinterpretable as 16 little-endian u16 limbs
+(the kernels' wire format) — the C side writes limbs by memcpy.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CANDIDATES = [
+    os.path.join(_HERE, "..", "..", "native", "libscalarmath.so"),
+    os.path.join(_HERE, "libscalarmath.so"),
+]
+
+_U64P = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
+_U16P = np.ctypeslib.ndpointer(dtype=np.uint16, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+_I32P = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+
+
+def _load():
+    for path in _CANDIDATES:
+        if not os.path.exists(path):
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            continue
+        lib.sm_version.restype = ctypes.c_int
+        if lib.sm_version() != 1:
+            continue
+        lib.sm_mulmod.restype = ctypes.c_int
+        lib.sm_mulmod.argtypes = [ctypes.c_int, _U64P, _U64P, _U64P]
+        lib.sm_mod512.restype = ctypes.c_int
+        lib.sm_mod512.argtypes = [ctypes.c_int, _U64P, _U64P]
+        lib.sm_glv.restype = ctypes.c_int
+        lib.sm_glv.argtypes = [_U64P, _U8P, _U64P, _U64P]
+        lib.sm_k1_prep.restype = ctypes.c_int
+        lib.sm_k1_prep.argtypes = [
+            ctypes.c_int64, _U64P, _U64P, _U64P, _U64P,
+            _I32P, _U8P, _U16P, _U16P, _U16P, _U16P, _U16P,
+            _U8P, _U8P, _U64P]
+        lib.sm_r1_prep.restype = ctypes.c_int
+        lib.sm_r1_prep.argtypes = [
+            ctypes.c_int64, _U64P, _U64P, _U64P, _U64P,
+            _I32P, _U8P, _U16P, _U16P, _U16P,
+            _U8P, _U8P, _U64P]
+        lib.sm_ed_prep.restype = ctypes.c_int
+        lib.sm_ed_prep.argtypes = [
+            ctypes.c_int64, _U64P, _U64P, _I32P, _I32P, _U8P, _U8P]
+        lib.sm_ed_prep_plain.restype = ctypes.c_int
+        lib.sm_ed_prep_plain.argtypes = [
+            ctypes.c_int64, _U64P, _U64P, _I32P, _U8P, _U8P]
+        return lib
+    return None
+
+
+_LIB = _load()
+
+#: Modulus ids for the test seams (must match scalarmath.cpp).
+MOD_K1_N, MOD_K1_P, MOD_R1_N, MOD_R1_P, MOD_ED_L, MOD_ED_P = range(6)
+
+
+def available() -> bool:
+    return _LIB is not None
+
+
+# ---------------------------------------------------------------------------
+# Host int <-> word-array conversion
+# ---------------------------------------------------------------------------
+
+def ints_to_words(xs, nwords: int = 4) -> np.ndarray:
+    """Python ints → (B, nwords) LE u64 array (one C-level to_bytes each)."""
+    nbytes = nwords * 8
+    buf = b"".join(int(x).to_bytes(nbytes, "little") for x in xs)
+    return np.frombuffer(buf, dtype="<u8").reshape(len(xs), nwords).copy()
+
+
+def digests_to_words(digests: list[bytes], nwords: int) -> np.ndarray:
+    """Big-endian digests (e.g. SHA-256 outputs) → (B, nwords) LE u64 words
+    of the digest interpreted as a big-endian integer."""
+    buf = b"".join(digests)
+    be = np.frombuffer(buf, dtype=">u8").reshape(len(digests), nwords)
+    return be[:, ::-1].astype("<u8")
+
+
+def le_digests_to_words(digests: list[bytes], nwords: int) -> np.ndarray:
+    """Little-endian-integer digests (RFC 8032 SHA-512) → LE u64 words."""
+    buf = b"".join(digests)
+    return np.frombuffer(buf, dtype="<u8").reshape(
+        len(digests), nwords).copy()
+
+
+# ---------------------------------------------------------------------------
+# Test seams
+# ---------------------------------------------------------------------------
+
+def mulmod(mod_id: int, a: int, b: int) -> int:
+    aw = ints_to_words([a])
+    bw = ints_to_words([b])
+    r = np.zeros((1, 4), dtype=np.uint64)
+    rc = _LIB.sm_mulmod(mod_id, aw, bw, r)
+    assert rc == 0, rc
+    return int.from_bytes(r.tobytes(), "little")
+
+
+def mod512(mod_id: int, x: int) -> int:
+    xw = ints_to_words([x], nwords=8)
+    r = np.zeros((1, 4), dtype=np.uint64)
+    rc = _LIB.sm_mod512(mod_id, xw, r)
+    assert rc == 0, rc
+    return int.from_bytes(r.tobytes(), "little")
+
+
+def glv(k: int) -> tuple[int, int]:
+    kw = ints_to_words([k])
+    negs = np.zeros(2, dtype=np.uint8)
+    a1 = np.zeros(2, dtype=np.uint64)
+    a2 = np.zeros(2, dtype=np.uint64)
+    rc = _LIB.sm_glv(kw, negs, a1, a2)
+    assert rc == 0, rc
+    k1 = int.from_bytes(a1.tobytes(), "little")
+    k2 = int.from_bytes(a2.tobytes(), "little")
+    return (-k1 if negs[0] else k1), (-k2 if negs[1] else k2)
+
+
+# ---------------------------------------------------------------------------
+# Batch preps
+# ---------------------------------------------------------------------------
+
+def k1_prep(e_words, r_words, s_words, pub_words):
+    """secp256k1 hybrid-GLV prep (w = 8).  All inputs (B, ·) u64 arrays.
+    Returns (g_idx(16,B) i32, q_packed(64,B) u8, qc_x, qc_y, qd_x, qd_y
+    (B,16) u16, r_limbs(B,16) u16, rn_ok(B) u8, precheck(B) bool)."""
+    n = len(e_words)
+    g_idx = np.empty((16, n), dtype=np.int32)
+    q_packed = np.empty((64, n), dtype=np.uint8)
+    qc_x = np.empty((n, 16), dtype=np.uint16)
+    qc_y = np.empty((n, 16), dtype=np.uint16)
+    qd_x = np.empty((n, 16), dtype=np.uint16)
+    qd_y = np.empty((n, 16), dtype=np.uint16)
+    r_limbs = np.empty((n, 16), dtype=np.uint16)
+    rn_ok = np.empty(n, dtype=np.uint8)
+    precheck = np.empty(n, dtype=np.uint8)
+    work = np.empty((3 * n, 4), dtype=np.uint64)
+    rc = _LIB.sm_k1_prep(
+        n, np.ascontiguousarray(e_words), np.ascontiguousarray(r_words),
+        np.ascontiguousarray(s_words), np.ascontiguousarray(pub_words),
+        g_idx, q_packed, qc_x, qc_y, qd_x, qd_y, r_limbs,
+        rn_ok, precheck, work)
+    if rc != 0:
+        raise RuntimeError(f"sm_k1_prep failed: {rc}")
+    return (g_idx, q_packed, qc_x, qc_y, qd_x, qd_y, r_limbs,
+            rn_ok, precheck.astype(bool))
+
+
+def r1_prep(e_words, r_words, s_words, pub_words):
+    """secp256r1 single-scalar windowed prep (w = 16)."""
+    n = len(e_words)
+    g_idx = np.empty((16, n), dtype=np.int32)
+    q_digits = np.empty((128, n), dtype=np.uint8)
+    q_x = np.empty((n, 16), dtype=np.uint16)
+    q_y = np.empty((n, 16), dtype=np.uint16)
+    r_limbs = np.empty((n, 16), dtype=np.uint16)
+    rn_ok = np.empty(n, dtype=np.uint8)
+    precheck = np.empty(n, dtype=np.uint8)
+    work = np.empty((3 * n, 4), dtype=np.uint64)
+    rc = _LIB.sm_r1_prep(
+        n, np.ascontiguousarray(e_words), np.ascontiguousarray(r_words),
+        np.ascontiguousarray(s_words), np.ascontiguousarray(pub_words),
+        g_idx, q_digits, q_x, q_y, r_limbs, rn_ok, precheck, work)
+    if rc != 0:
+        raise RuntimeError(f"sm_r1_prep failed: {rc}")
+    return (g_idx, q_digits, q_x, q_y, r_limbs, rn_ok, precheck.astype(bool))
+
+
+def ed_prep(h_words, s_words):
+    """Ed25519 split-k prep: returns (b_idx(8,B), b2_idx(8,B) i32,
+    a_packed(64,B) u8, s_ok(B) bool)."""
+    n = len(h_words)
+    b_idx = np.empty((8, n), dtype=np.int32)
+    b2_idx = np.empty((8, n), dtype=np.int32)
+    a_packed = np.empty((64, n), dtype=np.uint8)
+    s_ok = np.empty(n, dtype=np.uint8)
+    rc = _LIB.sm_ed_prep(
+        n, np.ascontiguousarray(h_words), np.ascontiguousarray(s_words),
+        b_idx, b2_idx, a_packed, s_ok)
+    if rc != 0:
+        raise RuntimeError(f"sm_ed_prep failed: {rc}")
+    return b_idx, b2_idx, a_packed, s_ok.astype(bool)
+
+
+def ed_prep_plain(h_words, s_words):
+    """Ed25519 plain windowed prep: (b_idx(16,B) i32, a_digits(128,B) u8,
+    s_ok(B) bool)."""
+    n = len(h_words)
+    b_idx = np.empty((16, n), dtype=np.int32)
+    a_digits = np.empty((128, n), dtype=np.uint8)
+    s_ok = np.empty(n, dtype=np.uint8)
+    rc = _LIB.sm_ed_prep_plain(
+        n, np.ascontiguousarray(h_words), np.ascontiguousarray(s_words),
+        b_idx, a_digits, s_ok)
+    if rc != 0:
+        raise RuntimeError(f"sm_ed_prep_plain failed: {rc}")
+    return b_idx, a_digits, s_ok.astype(bool)

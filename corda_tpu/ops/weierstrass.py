@@ -769,6 +769,21 @@ def prepare_batch_windowed_single(curve: WeierstrassCurve, items,
     indices, u2 → 2-bit Q digits grouped per outer step, Q affine, r + the
     r+n-valid flag, the device-committed G table (appended before precheck
     so ``*args, precheck`` callers pass through)."""
+    from . import scalarprep as sp
+    if w == 16 and curve.name == "secp256r1" and sp.available():
+        e_words, r_words, s_words, pub_words = _items_to_words(items)
+        (g_idx, q_digits, q_x, q_y, r_limbs, rn_ok,
+         precheck) = sp.r1_prep(e_words, r_words, s_words, pub_words)
+        return (jnp.asarray(g_idx),
+                jnp.asarray(q_digits.reshape(256 // w, w // 2, len(items))),
+                (jnp.asarray(q_x), jnp.asarray(q_y)),
+                jnp.asarray(r_limbs), jnp.asarray(rn_ok),
+                *g_window_table_single_device(curve, w), precheck)
+    return _prepare_windowed_single_python(curve, items, w)
+
+
+def _prepare_windowed_single_python(curve: WeierstrassCurve, items,
+                                    w: int = R1_G_WINDOW):
     precheck, pubs, u1s, u2s, r0, _ = _precheck_and_scalars(curve, items)
     g_idx = _bits_to_w_windows(F.scalars_to_bits(u1s), w).astype(np.int32)
     digs = _bits_to_windows(F.scalars_to_bits(u2s)).astype(np.uint8)
@@ -896,13 +911,66 @@ def _bits_to_w_windows(bits: np.ndarray, w: int) -> np.ndarray:
     return np.tensordot(weights, grouped.astype(np.uint32), axes=([0], [1]))
 
 
+def _items_to_words(items):
+    """(pub, msg, r, s) items → (e, r, s, pub) LE u64 word arrays for the
+    native prep (one C-level to_bytes/hash per item — no bigint loops).
+    Out-of-range values (negative, ≥ 2^256 — e.g. a hostile DER integer or
+    an off-range point) are clamped to encodings the C precheck REJECTS, so
+    a malformed item yields a per-item False verdict exactly like the
+    Python path, never a batch-level exception."""
+    from . import scalarprep as sp
+    digests = [hashlib.sha256(msg).digest() for _, msg, _, _ in items]
+    e_words = sp.digests_to_words(digests, 4)
+    in_range = lambda v: 0 <= v < (1 << 256)
+    r_words = sp.ints_to_words([r if in_range(r) else 0
+                                for _, _, r, _ in items])
+    s_words = sp.ints_to_words([s if in_range(s) else 0
+                                for _, _, _, s in items])
+    pub_buf = b"".join(
+        (pt[0].to_bytes(32, "little") + pt[1].to_bytes(32, "little"))
+        if (pt is not None and in_range(pt[0]) and in_range(pt[1]))
+        else bytes(64)
+        for pt, _, _, _ in items)
+    pub_words = np.frombuffer(pub_buf, dtype="<u8").reshape(len(items), 8)
+    return e_words, r_words, s_words, pub_words
+
+
+def _prepare_hybrid_native(items, g_w: int):
+    """Native (C) fast path of prepare_batch_hybrid_wide for g_w = 8: the
+    whole scalar layer (precheck, batch s-inversion, GLV split, window
+    extraction, limb packing) runs in native/scalarmath.cpp — bit-identical
+    outputs to the Python path (tests/test_scalarprep.py)."""
+    from . import scalarprep as sp
+    curve = CURVES["secp256k1"]
+    e_words, r_words, s_words, pub_words = _items_to_words(items)
+    (g_idx, q_packed, qc_x, qc_y, qd_x, qd_y, r_limbs,
+     rn_ok, precheck) = sp.k1_prep(e_words, r_words, s_words, pub_words)
+    n_g = 128 // g_w
+    q_bits = q_packed.reshape(n_g, g_w // 2, len(items))
+    return (jnp.asarray(g_idx), jnp.asarray(q_bits),
+            (jnp.asarray(qc_x), jnp.asarray(qc_y)),
+            (jnp.asarray(qd_x), jnp.asarray(qd_y)),
+            jnp.asarray(r_limbs), jnp.asarray(rn_ok),
+            *g_window_table_device(curve, g_w), precheck)
+
+
 def prepare_batch_hybrid_wide(items, g_w: int):
     """Host prep for the wide-G hybrid kernel: GLV-decompose u1 (G legs:
     g_w-bit digits + signs into the gather index — one gather per g_w bits)
     and u2 (Q legs: 2-bit per-item windows, signs folded into the points),
-    with the Q window planes grouped per outer step."""
+    with the Q window planes grouped per outer step.
+
+    Dispatches to the native (C) scalar layer when libscalarmath is
+    available — bit-identical outputs (tests/test_scalarprep.py)."""
     if g_w % 2 or g_w < 2:
         raise ValueError(f"g_w must be even and >= 2, got {g_w}")
+    from . import scalarprep as sp
+    if g_w == 8 and sp.available():
+        return _prepare_hybrid_native(items, g_w)
+    return _prepare_hybrid_python(items, g_w)
+
+
+def _prepare_hybrid_python(items, g_w: int):
     curve = CURVES["secp256k1"]
     p = curve.p
     precheck, pubs, u1s, u2s, r0, r1 = _precheck_and_scalars(curve, items)

@@ -83,6 +83,28 @@ def sharded_ed25519_verify_windowed(mesh: Mesh):
     return jax.jit(shmapped)
 
 
+def sharded_ed25519_verify_split(mesh: Mesh):
+    """Batch-sharded Ed25519 verify over the SPLIT-K half-length ladder —
+    the fastest single-chip path (ops.ed25519.verify_core_split), scaled
+    the same dp way: both Niels tables (B and [2^128]B) replicated per
+    chip, batch axis sharded.
+
+    Input layout (from ops.ed25519.prepare_batch_split): b_idx/b2_idx
+    (128/w, B); a_packed (128/w, w/2, B); neg_a/neg_a2 affine 3×(B, 16);
+    r_y (B, 16); r_sign (B,); six replicated table arrays."""
+    core = functools.partial(ed_ops.verify_core_split,
+                             w=ed_ops.SPLIT_B_WINDOW)
+    shmapped = jax.shard_map(
+        core, mesh=mesh,
+        in_specs=(P(None, AXIS), P(None, AXIS), P(None, None, AXIS),
+                  (P(AXIS, None),) * 3, (P(AXIS, None),) * 3,
+                  P(AXIS, None), P(AXIS),
+                  *((P(None, None),) * 6)),
+        out_specs=P(AXIS),
+        check_vma=False)  # see sharded_ed25519_verify
+    return jax.jit(shmapped)
+
+
 def sharded_ecdsa_verify(mesh: Mesh, curve_name: str):
     """Same as sharded_ed25519_verify for the Weierstrass ECDSA kernel.
 
@@ -167,14 +189,16 @@ def sharded_verify_batch_ed25519(mesh: Mesh, items, _cache={}):
     if n == 0:
         return np.zeros(0, dtype=bool)
     padded = items + [items[-1]] * (_pad_to_mesh_bucket(n, mesh) - n)
-    *args, precheck = ed_ops.prepare_batch_windowed(
-        padded, ed_ops.B_WINDOW, device_tables=False)
+    *args, precheck = ed_ops.prepare_batch_split(
+        padded, ed_ops.SPLIT_B_WINDOW, device_tables=False)
     key = ("ed25519", id(mesh))
     if key not in _cache:
         rep = jax.NamedSharding(mesh, P())
+        w = ed_ops.SPLIT_B_WINDOW
         tabs = tuple(jax.device_put(t, rep)
-                     for t in ed_ops._b_window_table(ed_ops.B_WINDOW))
-        _cache[key] = (sharded_ed25519_verify_windowed(mesh), tabs)
+                     for t in (*ed_ops._b_window_table(w, 0),
+                               *ed_ops._b_window_table(w, 128)))
+        _cache[key] = (sharded_ed25519_verify_split(mesh), tabs)
     fn, tabs = _cache[key]
     ok = np.asarray(fn(*args, *tabs))
     return (ok & precheck)[:n]

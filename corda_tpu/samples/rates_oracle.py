@@ -99,6 +99,39 @@ class RatesOracle:
                 "agreeable Fix commands")
         return self.hub.sign(ftx.root_hash.bytes, me.owning_key)
 
+    def sign_batch(self, ftxs) -> list:
+        """Bulk attestation: verify EVERY tear-off's Merkle proof in one
+        device-batched pass (core.transactions.batch_merkle — the
+        NodeInterestRates.kt:149-180 hot path at load, BASELINE config 3),
+        then apply the same per-item acceptance policy as :meth:`sign`.
+        Returns one DigitalSignatureWithKey or FlowException per ftx —
+        per-item isolation: a bad proof never blocks the rest of the
+        batch."""
+        from ..core.transactions.batch_merkle import verify_filtered_batch
+        proofs_ok = verify_filtered_batch(ftxs)
+        me = self.hub.my_info.legal_identity
+
+        def acceptable(component) -> bool:
+            from ..core.contracts.structures import Command
+            if isinstance(component, Command):
+                return (isinstance(component.value, Fix)
+                        and me.owning_key in component.signers
+                        and self.fixes.get(component.value.of)
+                        == component.value.value_bp)
+            return False
+
+        out = []
+        for ftx, ok in zip(ftxs, proofs_ok):
+            if not ok:
+                out.append(FlowException("Tear-off failed Merkle verification"))
+            elif not ftx.filtered_leaves.check_with_fun(acceptable):
+                out.append(FlowException(
+                    "Oracle refuses: revealed components are not exactly "
+                    "agreeable Fix commands"))
+            else:
+                out.append(self.hub.sign(ftx.root_hash.bytes, me.owning_key))
+        return out
+
 
 # ---------------------------------------------------------------------------
 # Client flows (RatesFixFlow split into its query/sign sub-flows)

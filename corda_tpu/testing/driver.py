@@ -25,7 +25,7 @@ class NodeHandle:
     name: str
     host: str
     port: int
-    process: subprocess.Popen
+    process: "object"            # testing.runner.ProcessHandle
     rpc: CordaRPCClient
     # spawn configuration, so restart_node restores the SAME role
     notary: str | None = None
@@ -48,7 +48,7 @@ class VerifierHandle:
 
     host: str
     port: int
-    process: subprocess.Popen
+    process: "object"            # testing.runner.ProcessHandle
     stats_file: str | None = None
 
     @property
@@ -70,9 +70,16 @@ class VerifierHandle:
 
 
 class DriverDSL:
-    def __init__(self, base_dir: str, startup_timeout_s: float = 60.0):
+    def __init__(self, base_dir: str, startup_timeout_s: float = 60.0,
+                 runner=None):
+        """``runner``: a testing.runner.NodeRunner — LocalRunner (default)
+        spawns subprocesses on this machine; SSHRunner places the same
+        processes on a remote host with identical lifecycle/disruption
+        semantics (LoadTest.kt's ssh-managed cluster)."""
+        from .runner import LocalRunner
         self.base_dir = str(base_dir)
         self.startup_timeout_s = startup_timeout_s
+        self.runner = runner if runner is not None else LocalRunner()
         self.nodes: list[NodeHandle] = []
         self.verifiers: list[VerifierHandle] = []
         self.map_handle: NodeHandle | None = None
@@ -142,8 +149,7 @@ class DriverDSL:
         env.setdefault("PYTHONPATH", os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))))
         env.update(extra_env or {})
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True, env=env)
+        proc = self.runner.spawn(cmd, env=env)
         host, port = await_node_ready(proc, "verifier",
                                       self.startup_timeout_s,
                                       ready_prefix="VERIFIER READY")
@@ -164,7 +170,7 @@ class DriverDSL:
                verifier_type: str = "InMemory") -> NodeHandle:
         node_dir = os.path.join(self.base_dir,
                                 name.replace("=", "_").replace(", ", "_"))
-        os.makedirs(node_dir, exist_ok=True)
+        self.runner.prepare_dir(node_dir)
         cmd = [sys.executable, "-m", "corda_tpu.node", "--name", name,
                "--port", "0", "--base-dir", node_dir, "--quiet",
                "--verifier-type", verifier_type]
@@ -178,8 +184,7 @@ class DriverDSL:
         env = dict(os.environ)
         env.setdefault("PYTHONPATH", os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))))
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True, env=env)
+        proc = self.runner.spawn(cmd, env=env)
         # await_node_ready's reader thread keeps draining stdout for the
         # process lifetime, so the node never blocks on a full pipe
         host, port = await_node_ready(proc, name, self.startup_timeout_s)
@@ -190,7 +195,7 @@ class DriverDSL:
         return handle
 
 
-def await_node_ready(proc: subprocess.Popen, name: str,
+def await_node_ready(proc, name: str,
                      timeout_s: float = 60.0,
                      ready_prefix: str = "NODE READY"):
     """Block until a node subprocess prints its READY line (driver

@@ -119,22 +119,19 @@ class HangProcess(Disruption):
     suspends the remote JVM over SSH); this is the local-process edition."""
 
     def __init__(self, pick: Callable[[Any], Any]):
-        import signal as _signal
         self.pick = pick
         self.name = "hang-process"
-        self._sigstop = _signal.SIGSTOP
-        self._sigcont = _signal.SIGCONT
         self._victim = None
 
     def apply(self, ctx) -> None:
-        import os as _os
+        # runner-agnostic: the ProcessHandle delivers SIGSTOP locally or
+        # via a remote `kill -STOP` over the SSH transport (testing.runner)
         self._victim = self.pick(ctx)
-        _os.kill(self._victim.process.pid, self._sigstop)
+        self._victim.process.suspend()
 
     def restore(self, ctx) -> None:
-        import os as _os
         if self._victim is not None:
-            _os.kill(self._victim.process.pid, self._sigcont)
+            self._victim.process.resume()
             self._victim = None
 
 

@@ -191,14 +191,25 @@ class SignatureBatcher:
                 if (self.host_crossover <= depth < self.max_batch
                         and not self._closed and any(self._queues.values())):
                     import time as _time
+                    # Dispatch-on-crossover (VERDICT r4 #7): the window is
+                    # bounded by max_latency_s but FLUSHES EARLY as soon as
+                    # one tick passes with no queue growth — an atomic
+                    # burst (one submit_group) stops paying the whole
+                    # linger, while a trickling burst keeps coalescing
+                    # because every enqueue notifies the condition.
                     deadline = _time.monotonic() + self.max_latency_s
+                    tick = self.max_latency_s / 5
                     while not self._closed and depth < self.max_batch:
                         remaining = deadline - _time.monotonic()
                         if remaining <= 0:
                             break
-                        self._lock.wait(timeout=remaining)
-                        depth = max((len(q) for q in self._queues.values()),
-                                    default=0)
+                        self._lock.wait(timeout=min(remaining, tick))
+                        new_depth = max((len(q)
+                                         for q in self._queues.values()),
+                                        default=0)
+                        if new_depth == depth:
+                            break           # stalled: flush what we have
+                        depth = new_depth
                 drained = {name: q[: self.max_batch]
                            for name, q in self._queues.items() if q}
                 for name, items in drained.items():

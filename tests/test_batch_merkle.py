@@ -1,0 +1,105 @@
+"""Batch device Merkle proof verification vs the host path (bit-exact) and
+the oracle bulk-signing consumer.
+
+Reference workload: NodeInterestRates.kt:149-180 oracle attestation over
+FilteredTransactions (MerkleTransaction.kt:70-170) — BASELINE config 3.
+"""
+import numpy as np
+import pytest
+
+from corda_tpu.core.contracts import Command, TransactionState
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.core.transactions.batch_merkle import (batch_roots,
+                                                      verify_filtered_batch)
+from corda_tpu.core.transactions.filtered import FilteredTransaction
+from corda_tpu.core.transactions import WireTransaction
+from corda_tpu.testing import DummyContract, DummyState, MockNetwork
+
+
+def _wtxs(n, alice, oracle_node, notary, fix_cls, fix_of):
+    out = []
+    for i in range(n):
+        out.append(WireTransaction(
+            outputs=(TransactionState(
+                DummyState(i + 1, (alice.party.owning_key,)), notary.party),),
+            commands=(
+                Command(DummyContract.Create(), (alice.party.owning_key,)),
+                Command(fix_cls(fix_of, 525),
+                        (oracle_node.party.owning_key,)),
+            ),
+            notary=notary.party,
+            must_sign=(alice.party.owning_key,
+                       oracle_node.party.owning_key)))
+    return out
+
+
+def _fixture():
+    from corda_tpu.samples.rates_oracle import Fix, FixOf, RatesOracle
+    fix_of = FixOf("ICE LIBOR", "2016-03-16", "3M")
+    network = MockNetwork()
+    notary = network.create_notary_node()
+    oracle_node = network.create_node("O=Rates Oracle, L=London, C=GB")
+    alice = network.create_node("O=Alice, L=Madrid, C=ES")
+    network.start_nodes()
+    oracle = RatesOracle(oracle_node.services, {fix_of: 525})
+    return network, notary, oracle_node, alice, oracle, Fix, fix_of
+
+
+def test_batch_verify_matches_host_and_rejects_tampered():
+    network, notary, oracle_node, alice, oracle, Fix, fix_of = _fixture()
+    wtxs = _wtxs(8, alice, oracle_node, notary, Fix, fix_of)
+    ftxs = [w.build_filtered_transaction(
+        lambda c: isinstance(c, Command) and isinstance(c.value, Fix))
+        for w in wtxs]
+    # a reveal-all proof and a wider reveal exercise deeper rounds
+    ftxs.append(wtxs[0].build_filtered_transaction(lambda c: True))
+    # tampered root: proof must fail while others still verify
+    bad = FilteredTransaction(SecureHash.sha256(b"wrong"),
+                              ftxs[0].filtered_leaves,
+                              ftxs[0].partial_merkle_tree)
+    ftxs.append(bad)
+    got = verify_filtered_batch(ftxs, device_crossover=2)   # force device
+    want = []
+    for ftx in ftxs:
+        try:
+            want.append(ftx.verify())
+        except ValueError:
+            want.append(False)
+    assert got == want
+    assert got[:-1] == [True] * (len(ftxs) - 1) and got[-1] is False
+    # host-only routing must agree with the device routing
+    assert verify_filtered_batch(ftxs, use_device=False) == got
+
+
+def test_batch_roots_matches_host():
+    from corda_tpu.core.crypto.merkle import MerkleTree
+    rng = np.random.default_rng(9)
+    lists = []
+    for n in (1, 2, 3, 5, 8, 16):
+        lists.append([SecureHash.sha256(rng.bytes(16)) for _ in range(n)])
+    got = batch_roots(lists, device_crossover=1)            # force device
+    want = [MerkleTree.root_hash(hs) for hs in lists]
+    assert got == want
+    assert batch_roots(lists, use_device=False) == want
+
+
+def test_oracle_sign_batch():
+    network, notary, oracle_node, alice, oracle, Fix, fix_of = _fixture()
+    wtxs = _wtxs(4, alice, oracle_node, notary, Fix, fix_of)
+    ftxs = [w.build_filtered_transaction(
+        lambda c: isinstance(c, Command) and isinstance(c.value, Fix))
+        for w in wtxs]
+    # one bad proof + one over-revealed tx the oracle must refuse
+    ftxs.append(FilteredTransaction(SecureHash.sha256(b"no"),
+                                    ftxs[0].filtered_leaves,
+                                    ftxs[0].partial_merkle_tree))
+    ftxs.append(wtxs[0].build_filtered_transaction(lambda c: True))
+    out = oracle.sign_batch(ftxs)
+    for i, (ftx, res) in enumerate(zip(ftxs[:4], out[:4])):
+        assert not isinstance(res, Exception), res
+        res.verify(ftx.root_hash.bytes)
+    assert isinstance(out[4], Exception) and "Merkle" in str(out[4])
+    assert isinstance(out[5], Exception) and "refuses" in str(out[5])
+    # batch results agree with the single-item path
+    single = oracle.sign(ftxs[0])
+    single.verify(ftxs[0].root_hash.bytes)

@@ -1,0 +1,84 @@
+"""Node-runner abstraction tests: local lifecycle, SSH command layer, and
+the SSH lifecycle through a fake (bash -c) transport — no live remote
+needed (LoadTest.kt / NodeConnection.kt parity surface)."""
+import os
+import sys
+import time
+
+import pytest
+
+from corda_tpu.testing.runner import (LocalRunner, SSHRunner, _PID_MARKER)
+
+_SLEEPER = [sys.executable, "-u", "-c",
+            "import time\nprint('up', flush=True)\n"
+            "time.sleep(60)"]
+
+
+def _state(pid: int) -> str:
+    with open(f"/proc/{pid}/stat") as f:
+        return f.read().split(")")[-1].split()[0]
+
+
+def test_local_runner_lifecycle():
+    h = LocalRunner().spawn(_SLEEPER)
+    try:
+        assert h.stdout.readline().strip() == "up"
+        assert h.poll() is None
+        h.suspend()
+        time.sleep(0.1)
+        assert _state(h.pid) == "T"          # stopped
+        h.resume()
+        time.sleep(0.1)
+        assert _state(h.pid) in ("S", "R")
+    finally:
+        h.kill()
+        h.wait(timeout=10)
+    assert h.poll() is not None
+
+
+def test_ssh_command_layer_argv():
+    r = SSHRunner("box1", user="corda")
+    assert r.argv("echo hi") == ["ssh", "-o", "BatchMode=yes",
+                                 "corda@box1", "echo hi"]
+    cmd = r.remote_command(["python3", "-m", "corda_tpu.node",
+                            "--name", "O=A, L=B, C=GB"],
+                           env={"JAX_PLATFORMS": "cpu"}, cwd="/data/n1")
+    # shape: cd <dir>; echo <marker> $$; exec ENV... argv... 2>&1
+    assert cmd.startswith("cd /data/n1; ")
+    assert f"echo {_PID_MARKER} $$" in cmd
+    assert "exec env JAX_PLATFORMS=cpu python3 -m corda_tpu.node" in cmd
+    # the multi-word name must be quoted for the remote shell
+    assert "'O=A, L=B, C=GB'" in cmd
+
+
+def test_ssh_runner_lifecycle_through_fake_transport(tmp_path):
+    """The full spawn/suspend/kill cycle through the SSH command layer,
+    with bash -c standing in for the remote shell: every signal is
+    delivered by the runner's `kill` commands, not by local Popen calls —
+    exactly what a real ssh transport would execute."""
+    r = SSHRunner("fake", transport=["bash", "-c"])
+    r.prepare_dir(str(tmp_path / "remote"))
+    assert (tmp_path / "remote").is_dir()
+    h = r.spawn(_SLEEPER, env={"X_MARKER": "1"}, cwd=str(tmp_path))
+    try:
+        assert h.pid > 0
+        assert h.stdout.readline().strip() == "up"
+        h.suspend()
+        time.sleep(0.1)
+        assert _state(h.pid) == "T"
+        h.resume()
+        time.sleep(0.1)
+        assert _state(h.pid) in ("S", "R")
+    finally:
+        h.kill()
+    # the remote process is gone (kill -KILL through the transport)
+    with pytest.raises(OSError):
+        os.kill(h.pid, 0)
+
+
+def test_ssh_runner_one_shot_run():
+    r = SSHRunner("fake", transport=["bash", "-c"])
+    out = r.run("echo remote-ok")
+    assert out.stdout.strip() == "remote-ok"
+    with pytest.raises(RuntimeError):
+        r.run("exit 3")

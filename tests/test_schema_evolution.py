@@ -1,0 +1,201 @@
+"""Schema-evolution interop: v1 and v2 of a carried-schema state type must
+round-trip between peers on different versions.
+
+Reference direction: ClassCarpenter.kt:30-447 + amqp/SerializerFactory.kt
+(the carpenter/AMQP subsystem is the beginning of versioned evolution);
+VERDICT r4 ask #8.  The two-version MockNetwork test flips the process
+registry between the SEND serialization and the DELIVERY deserialization
+via the bus transfer observer — the wire bytes cross a real version
+boundary inside one deterministic process.
+"""
+import dataclasses
+
+import pytest
+
+from corda_tpu.core.serialization import SerializationError, codec
+from corda_tpu.flows import FlowLogic, Receive, Send, SendAndReceive
+from corda_tpu.flows.api import initiated_by, initiating_flow
+from corda_tpu.testing import MockNetwork
+
+NAME = "evolution.DemoState"
+
+
+@dataclasses.dataclass(frozen=True)
+class DemoStateV1:
+    amount: int
+    legacy_note: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DemoStateV2:
+    """v2: ``legacy_note`` removed, ``memo`` added WITH a default."""
+
+    amount: int
+    memo: str = "v2-default"
+
+
+@dataclasses.dataclass(frozen=True)
+class DemoStateV2Strict:
+    """An added field WITHOUT a default: incompatible with v1 senders."""
+
+    amount: int
+    required_new: str
+
+
+def _register(cls):
+    codec.register_type(NAME, cls, carry_schema=True)
+
+
+def _unregister(cls):
+    codec._REGISTRY.pop(NAME, None)
+    codec._BY_CLASS.pop(cls, None)
+    codec._SCHEMA_NAMES.pop(NAME, None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    for cls in (DemoStateV1, DemoStateV2, DemoStateV2Strict):
+        _unregister(cls)
+    entry = codec._CARPENTED.pop(NAME, None)
+    if entry is not None:
+        for cls, cname in list(codec._CARPENTED_BY_CLASS.items()):
+            if cname == NAME:
+                del codec._CARPENTED_BY_CLASS[cls]
+
+
+def test_v1_wire_decodes_on_v2_with_default():
+    _register(DemoStateV1)
+    blob = codec.serialize(DemoStateV1(7, "old"))
+    _unregister(DemoStateV1)
+    _register(DemoStateV2)
+    got = codec.deserialize(blob)
+    assert got == DemoStateV2(amount=7, memo="v2-default")
+
+
+def test_v2_wire_decodes_on_v1_dropping_added_field():
+    _register(DemoStateV2)
+    blob = codec.serialize(DemoStateV2(9, memo="note"))
+    _unregister(DemoStateV2)
+    _register(DemoStateV1)
+    with pytest.raises(SerializationError):
+        codec.deserialize(blob)   # v1's legacy_note has NO default
+
+
+def test_two_way_round_trip_with_defaults():
+    """v1 ⇄ v2 when every version-unique field has a default."""
+
+    @dataclasses.dataclass(frozen=True)
+    class V1:
+        amount: int
+        legacy_note: str = "none"
+
+    codec.register_type(NAME, V1, carry_schema=True)
+    blob_v1 = codec.serialize(V1(3, "hello"))
+    _unregister(V1)
+    _register(DemoStateV2)
+    got_v2 = codec.deserialize(blob_v1)       # legacy dropped, memo default
+    assert got_v2 == DemoStateV2(3, "v2-default")
+    blob_v2 = codec.serialize(got_v2)
+    _unregister(DemoStateV2)
+    codec.register_type(NAME, V1, carry_schema=True)
+    got_v1 = codec.deserialize(blob_v2)       # memo dropped, legacy default
+    assert got_v1 == V1(3, "none")
+    _unregister(V1)
+
+
+def test_incompatible_added_field_fails_typed():
+    _register(DemoStateV1)
+    blob = codec.serialize(DemoStateV1(1, "x"))
+    _unregister(DemoStateV1)
+    _register(DemoStateV2Strict)
+    with pytest.raises(SerializationError, match="no default"):
+        codec.deserialize(blob)
+
+
+def test_carpented_union_evolution():
+    """A receiver WITHOUT the class sees two schema versions of one name:
+    both materialize; the union bag re-serializes under the union schema;
+    a pre-evolution bag stays bit-exact."""
+    _register(DemoStateV1)
+    blob_v1 = codec.serialize(DemoStateV1(5, "legacy"))
+    _unregister(DemoStateV1)
+    bag_v1 = codec.deserialize(blob_v1)                  # carpents v1 schema
+    assert codec.serialize(bag_v1) == blob_v1            # bit-exact
+    _register(DemoStateV2)
+    blob_v2 = codec.serialize(DemoStateV2(6, "m"))
+    _unregister(DemoStateV2)
+    bag_v2 = codec.deserialize(blob_v2)                  # triggers the union
+    assert type(bag_v2).__corda_carpented_fields__ == [
+        "amount", "legacy_note", "memo"]
+    assert (bag_v2.amount, bag_v2.legacy_note, bag_v2.memo) == (6, None, "m")
+    # the union class now serves OLD wire forms too
+    bag_v1_again = codec.deserialize(blob_v1)
+    assert type(bag_v1_again) is type(bag_v2)
+    assert (bag_v1_again.amount, bag_v1_again.legacy_note,
+            bag_v1_again.memo) == (5, "legacy", None)
+    # union bags round-trip under the union schema
+    rt = codec.deserialize(codec.serialize(bag_v2))
+    assert rt == bag_v2
+    # the PRE-evolution bag still re-serializes bit-exactly
+    assert codec.serialize(bag_v1) == blob_v1
+
+
+# ---------------------------------------------------------------------------
+# Two-version MockNetwork interop
+# ---------------------------------------------------------------------------
+
+@initiating_flow
+class SendStateFlow(FlowLogic):
+    def __init__(self, peer, state):
+        self.peer = peer
+        self.state = state
+
+    def call(self):
+        resp = yield SendAndReceive(self.peer, self.state, object)
+        return resp.unwrap(lambda d: d)
+
+
+@initiated_by(SendStateFlow)
+class ReceiveStateFlow(FlowLogic):
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        msg = yield Receive(self.peer, object)
+        got = msg.unwrap(lambda d: d)
+        yield Send(self.peer, ("ack", got))
+        return got
+
+
+def test_two_version_mocknetwork_interop():
+    """Node A serializes a v1 state onto the bus; the process 'upgrades' to
+    v2 while the message is in flight (bus transfer observer = the version
+    boundary); node B decodes and ACKS a v2 instance — and A (now also v2)
+    decodes the echoed state."""
+    network = MockNetwork()
+    a = network.create_node("O=A, L=London, C=GB")
+    b = network.create_node("O=B, L=Paris, C=FR")
+    network.start_nodes()
+
+    _register(DemoStateV1)
+    fsm = a.start_flow(SendStateFlow(b.party, DemoStateV1(11, "pre")))
+
+    upgraded = []
+
+    def upgrade_once(transfer):
+        # flip versions on the transfer CARRYING the v1 payload: it is
+        # already serialized (v1 bytes in flight), not yet delivered —
+        # exactly the cross-version wire boundary
+        if not upgraded and NAME.encode() in transfer.message.data:
+            _unregister(DemoStateV1)
+            _register(DemoStateV2)
+            upgraded.append(True)
+        return True
+
+    network.bus.transfer_filter = upgrade_once
+    network.run_network()
+    ack, got = fsm.result_future.result(timeout=5)
+    assert upgraded, "version boundary never crossed"
+    assert ack == "ack"
+    assert got == DemoStateV2(amount=11, memo="v2-default")

@@ -194,10 +194,15 @@ def test_carpented_unknown_type_roundtrip():
                                                         b"\x01\x02")
         # the bag re-serializes BIT-EXACTLY (relay/storage round-trip)
         assert codec.serialize(got) == blob
-        # same schema carpents once; a conflicting schema is rejected
+        # same schema carpents once; a DIFFERENT schema unions (evolution —
+        # see tests/test_schema_evolution.py), while hostile names still fail
         assert type(codec.deserialize(blob)) is type(got)
+        union_cls = codec.carpented_class(name, ["issuer", "extra_field"])
+        assert union_cls is not type(got)
+        assert union_cls.__corda_carpented_fields__ == [
+            "issuer", "quantity", "memo", "extra_field"]
         with pytest.raises(SerializationError):
-            codec.carpented_class(name, ["different", "fields"])
+            codec.carpented_class(name, ["__class__"])
 
         # once the real class IS registered, it wins for new decodes
         codec.register_type(name, ThirdPartyState, carry_schema=True)
