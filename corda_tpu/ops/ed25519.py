@@ -311,15 +311,10 @@ def split_ladder(b_idx, b2_idx, a_packed, neg_a, neg_a2, btab, b2tab,
     tab2_p, tab2_m, tab2_td = b2tab
 
     def joint_addend(qb):
-        """qb: (B,) packed joint digit klo | khi<<2 — 16-way select tree
-        (same fold-by-bit shape as the k1 hybrid ladder's q_addend)."""
-        level = table
-        for j in range(4):
-            b = ((qb >> j) & 1).astype(jnp.bool_)
-            level = [tuple(F.select(b, hi_c, lo_c)
-                           for lo_c, hi_c in zip(lo, hi))
-                     for lo, hi in zip(level[0::2], level[1::2])]
-        return level[0]
+        """qb: (B,) packed joint digit klo | khi<<2 — the shared 16-way
+        select tree (weierstrass.select_tree)."""
+        from .weierstrass import select_tree
+        return select_tree(table, qb)
 
     def b_adds(acc, bi, b2i):
         acc = madd_niels(acc, tab_p[bi].astype(jnp.uint64),

@@ -41,7 +41,7 @@ def _load():
         except OSError:
             continue
         lib.sm_version.restype = ctypes.c_int
-        if lib.sm_version() != 1:
+        if lib.sm_version() != 2:
             continue
         lib.sm_mulmod.restype = ctypes.c_int
         lib.sm_mulmod.argtypes = [ctypes.c_int, _U64P, _U64P, _U64P]
@@ -169,10 +169,10 @@ def k1_prep(e_words, r_words, s_words, pub_words):
 
 
 def r1_prep(e_words, r_words, s_words, pub_words):
-    """secp256r1 single-scalar windowed prep (w = 16)."""
+    """secp256r1 single-scalar windowed prep (w = 16, 4-bit Q digits)."""
     n = len(e_words)
     g_idx = np.empty((16, n), dtype=np.int32)
-    q_digits = np.empty((128, n), dtype=np.uint8)
+    q_digits = np.empty((64, n), dtype=np.uint8)
     q_x = np.empty((n, 16), dtype=np.uint16)
     q_y = np.empty((n, 16), dtype=np.uint16)
     r_limbs = np.empty((n, 16), dtype=np.uint16)

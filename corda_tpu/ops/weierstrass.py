@@ -55,6 +55,22 @@ def _select4(idx, points):
         for c0, c1, c2, c3 in zip(*points))
 
 
+def select_tree(table, idx):
+    """16-way batched point select over a 16-entry table of coordinate
+    tuples: fold by index bit (LSB first) — a binary tree of 15 two-way
+    selects per coordinate.  (A flat masked-sum over a stacked table is
+    HBM-bound and costs more — BASELINE r1 dead end; u32-downcasting the
+    tree was measured FLAT on v5e.)  Shared by the k1 hybrid ladder, the
+    r1 windowed ladder, and the ed25519 split ladder."""
+    level = table
+    for j in range(4):
+        b = ((idx >> j) & 1).astype(jnp.bool_)
+        level = [tuple(F.select(b, hi_c, lo_c)
+                       for lo_c, hi_c in zip(lo, hi))
+                 for lo, hi in zip(level[0::2], level[1::2])]
+    return level[0]
+
+
 def _points_to_limbs(col):
     """Affine host points [(x, y)] → projective limb triple with Z = 1.
     Ships u16 (canonical 16-bit limbs); kernels upcast on device — u64 on
@@ -148,6 +164,99 @@ def _madd_k1(Pt, Qa, p: int, b3: int):
     return (X3, Y3, Z3)
 
 
+def _add_m3(Pt, Qt, p: int, b: int):
+    """Fused RCB complete addition for a = -3, general b (secp256r1):
+    RCB16 Algorithm 4 with products kept as raw column accumulators so
+    every linear combination normalizes ONCE — ~11 normalize walks vs the
+    ~25 the generic :func:`add`/:func:`_rcb_finish` path pays for the same
+    14 schoolbook products (b is a full-width constant here, unlike k1's
+    small b3).  With the P-256 signed Solinas fold (ops/field.py) walks
+    are the dominant per-op cost, so this is the r1 sibling of
+    :func:`_add_k1` (VERDICT r4 ask #4's second lever)."""
+    bc = _const(b, p)
+    X1, Y1, Z1 = Pt
+    X2, Y2, Z2 = Qt
+    m0 = F.mul_cols(X1, X2)
+    m1 = F.mul_cols(Y1, Y2)
+    m2 = F.mul_cols(Z1, Z2)
+    t3 = F.norm(F.col_acc(p, plus=[F.mul_cols(F.rel_add(X1, Y1),
+                                              F.rel_add(X2, Y2))],
+                          minus=[m0, m1]), p)           # X1Y2 + X2Y1
+    t4 = F.norm(F.col_acc(p, plus=[F.mul_cols(F.rel_add(Y1, Z1),
+                                              F.rel_add(Y2, Z2))],
+                          minus=[m1, m2]), p)           # Y1Z2 + Y2Z1
+    xz = F.norm(F.col_acc(p, plus=[F.mul_cols(F.rel_add(X1, Z1),
+                                              F.rel_add(X2, Z2))],
+                          minus=[m0, m2]), p)           # X1Z2 + X2Z1
+    t1n = F.norm(m1, p)
+    t2n = F.norm(m2, p)
+    return _m3_tail(p, bc, m0, t1n, t2n, t3, t4, xz)
+
+
+def _m3_tail(p: int, bc, m0, t1n, t2n, t3, t4, xz):
+    """Shared tail of the fused a = -3 add/madd: from the six symmetric
+    terms to (X3, Y3, Z3) in 5 walks (Algorithm 4's epilogue algebra)."""
+    # u = 3(xz - b·t2)
+    u = F.norm(F.scale_cols(
+        F.col_acc(p, plus=[F.rel(xz)], minus=[F.mul_cols(t2n, bc)]), 3), p)
+    # w = 3(b·xz - 3·t2 - t0)
+    w = F.norm(F.scale_cols(
+        F.col_acc(p, plus=[F.mul_cols(xz, bc)],
+                  minus=[F.scale_rel(t2n, 3), m0]), 3), p)
+    t0x3 = F.norm(F.scale_cols(m0, 3), p)               # 3·t0
+    Xm = F.rel_add(t1n, u)           # t1 + u, relaxed
+    Zm = F.rel_sub(t1n, u, p)        # t1 - u, relaxed
+    t0f = F.rel_sub(t0x3, F.scale_rel(t2n, 3), p)       # 3t0 - 3t2
+    X3 = F.norm(F.col_acc(p, plus=[F.mul_cols(t3, Xm)],
+                          minus=[F.mul_cols(t4, w)]), p)
+    Y3 = F.norm(F.col_acc(p, plus=[F.mul_cols(Xm, Zm),
+                                   F.mul_cols(t0f, w)]), p)
+    Z3 = F.norm(F.col_acc(p, plus=[F.mul_cols(t4, Zm),
+                                   F.mul_cols(t3, t0f)]), p)
+    return (X3, Y3, Z3)
+
+
+def _dbl_m3(Pt, p: int, b: int):
+    """Fused RCB complete doubling for a = -3, general b (secp256r1):
+    RCB16 Algorithm 6, column-fused — vs dbl-via-:func:`add`'s generic
+    path (~25 walks).  Complete for every input including the identity.
+
+    The three cross products are HALF-COST sum-squares (2XY = (X+Y)² -
+    X² - Y²) folded into the consuming walks.  Leaving X²/Z² as RAW
+    column accumulators to skip their walks was measured SLOWER on v5e
+    (12.2k vs 13.3k end-to-end): the widened DUS products cost more than
+    the walks saved — the same normalize-before-multiply law the k1
+    formulas follow."""
+    bc = _const(b, p)
+    X, Y, Z = Pt
+    m0n = F.norm(F.sqr_cols(X), p)
+    m1n = F.norm(F.sqr_cols(Y), p)
+    m2n = F.norm(F.sqr_cols(Z), p)
+    # 2XY = (X+Y)² - X² - Y², etc. — triangular squares beat full muls
+    xy2 = F.norm(F.col_acc(p, plus=[F.sqr_cols(F.rel_add(X, Y))],
+                           minus=[F.rel(m0n), F.rel(m1n)]), p)
+    xz2 = F.norm(F.col_acc(p, plus=[F.sqr_cols(F.rel_add(X, Z))],
+                           minus=[F.rel(m0n), F.rel(m2n)]), p)
+    yz2 = F.norm(F.col_acc(p, plus=[F.sqr_cols(F.rel_add(Y, Z))],
+                           minus=[F.rel(m1n), F.rel(m2n)]), p)
+    # u = 3(b·Z² - 2XZ)
+    u = F.norm(F.scale_cols(
+        F.col_acc(p, plus=[F.mul_cols(m2n, bc)], minus=[F.rel(xz2)]), 3), p)
+    # w = 3(b·2XZ - 3Z² - X²)
+    w = F.norm(F.scale_cols(
+        F.col_acc(p, plus=[F.mul_cols(xz2, bc)],
+                  minus=[F.scale_rel(m2n, 3), F.rel(m0n)]), 3), p)
+    Xm = F.rel_sub(m1n, u, p)        # Y² - u, relaxed
+    Ym = F.rel_add(m1n, u)           # Y² + u, relaxed
+    t0f = F.rel_sub(F.scale_rel(m0n, 3), F.scale_rel(m2n, 3), p)
+    X3 = F.norm(F.col_acc(p, plus=[F.mul_cols(Xm, xy2)],
+                          minus=[F.mul_cols(yz2, w)]), p)
+    Y3 = F.norm(F.col_acc(p, plus=[F.mul_cols(Xm, Ym),
+                                   F.mul_cols(t0f, w)]), p)
+    Z3 = F.norm(F.scale_cols(F.mul_cols(yz2, m1n), 4), p)
+    return (X3, Y3, Z3)
+
+
 def add(Pt, Qt, curve: WeierstrassCurve):
     """RCB16 complete projective addition, specialized at trace time.
 
@@ -156,8 +265,7 @@ def add(Pt, Qt, curve: WeierstrassCurve):
       drop out (RCB16 Algorithm 7 shape); with b3 = 21 small, both b3·x
       products are ``mul_const`` — 12 full field muls per point-add, fused
       column-level in :func:`_add_k1`.
-    - ``a ≡ -small`` (secp256r1, a = -3): a·x = -(|a|·x) via ``mul_const`` +
-      subtraction — 12 full muls + cheap constant muls.
+    - ``a = -3`` (secp256r1): Algorithm 4, column-fused in :func:`_add_m3`.
     - general a: Algorithm 1 verbatim.
     """
     doubling = Pt is Qt     # dbl-via-add: every cross product is a square
@@ -168,6 +276,9 @@ def add(Pt, Qt, curve: WeierstrassCurve):
     b3 = 3 * curve.b % p
     if a == 0 and b3 < F.MUL_CONST_MAX:
         return _add_k1(Pt, Qt, p, b3)
+    if a == p - 3:
+        return (_dbl_m3(Pt, p, curve.b % p) if doubling
+                else _add_m3(Pt, Qt, p, curve.b % p))
 
     def mul2(x, y):
         return F.sqr(x, p) if doubling else F.mul(x, y, p)
@@ -248,10 +359,21 @@ def _madd_w(Pt, Qa, curve: WeierstrassCurve):
     products collapse host-side — t2 = Z1, t4 = X1 + Z1·X2,
     t5 = Y1 + Z1·Y2 — saving three of the twelve full products. Complete
     for every projective P1; NOT valid for an identity addend (the
-    windowed ladder's table carries a validity flag)."""
+    windowed ladder's table carries a validity flag).  The a = -3 case
+    rides the column-fused tail (:func:`_m3_tail`)."""
     X1, Y1, Z1 = Pt
     X2, Y2 = Qa
     p = curve.p
+    if curve.a % p == p - 3:
+        bc = _const(curve.b % p, p)
+        m0 = F.mul_cols(X1, X2)
+        m1 = F.mul_cols(Y1, Y2)
+        t3 = F.norm(F.col_acc(p, plus=[F.mul_cols(F.rel_add(X1, Y1),
+                                                  F.rel_add(X2, Y2))],
+                              minus=[m0, m1]), p)
+        t4 = F.norm(F.col_acc(p, plus=[F.mul_cols(Z1, Y2), F.rel(Y1)]), p)
+        xz = F.norm(F.col_acc(p, plus=[F.mul_cols(Z1, X2), F.rel(X1)]), p)
+        return _m3_tail(p, bc, m0, F.norm(m1, p), Z1, t3, t4, xz)
     t0 = F.mul(X1, X2, p)
     t1 = F.mul(Y1, Y2, p)
     t3 = F.mul_of_sums(X1, Y1, X2, Y2, p)
@@ -694,32 +816,46 @@ def g_window_table_single_device(curve: WeierstrassCurve, w: int):
 R1_G_WINDOW = 16
 
 
+#: Per-item Q window width for the single-scalar ladder: 4-bit windows
+#: over a 16-entry {0..15}·Q per-batch table (14-op build) — 64 table
+#: adds instead of the 2-bit windows' 128 (measured on v5e, BASELINE r5).
+R1_Q_WINDOW = 4
+
+
+def _q_table_single(Q, curve: WeierstrassCurve):
+    """16-entry per-item table T[i] = [i]Q from AFFINE Q: 7 doublings +
+    7 complete MIXED adds, one-time per batch (the single-scalar sibling
+    of the k1 hybrid's joint Q table)."""
+    batch_shape = Q[0].shape[:-1]
+    one = F.one_like(Q[0])
+    T = [identity(batch_shape)] * 16
+    T[1] = (Q[0], Q[1], one)
+    for i in range(2, 16):
+        T[i] = (dbl(T[i // 2], curve) if i % 2 == 0
+                else _madd_w(T[i - 1], Q, curve))
+    return T
+
+
 def windowed_ladder_single(g_idx, q_digits, Q, gtab,
                            curve: WeierstrassCurve, w: int):
     """[u1]G + [u2]Q for a curve without an endomorphism: per outer step,
-    ``w`` bits — w doublings, w/2 Q adds (2-bit per-item windows over
-    {0, Q, 2Q, 3Q}) and ONE mixed G add gathered from the 2^w-entry
-    affine table (flag-selected identity rows). The r1 sibling of
-    hybrid_ladder_wide; it replaces the 256-add plain Shamir ladder.
+    ``w`` bits — w doublings, w/4 Q adds (4-bit per-item windows over the
+    16-entry {0..15}Q table) and ONE mixed G add gathered from the
+    2^w-entry affine table (flag-selected identity rows). The r1 sibling
+    of hybrid_ladder_wide; it replaces the 256-add plain Shamir ladder.
 
-    ``g_idx``: (256/w, B); ``q_digits``: (256/w, w/2, B) 2-bit digits;
+    ``g_idx``: (256/w, B); ``q_digits``: (256/w, w/4, B) 4-bit digits;
     ``Q``: affine (x, y) limb pair."""
     tab_x, tab_y, tab_ok = gtab
     # shape consistency against the static w (a mismatched caller would
     # otherwise be silently governed by the array shapes alone)
-    assert g_idx.shape[0] * w == 256 and q_digits.shape[1] * 2 == w, \
+    assert g_idx.shape[0] * w == 256 and q_digits.shape[1] * 4 == w, \
         (g_idx.shape, q_digits.shape, w)
     assert tab_x.shape[0] == 1 << w, (tab_x.shape, w)
-    batch_shape = Q[0].shape[:-1]
-    Pid = identity(batch_shape)
-    one = F.one_like(Q[0])
-    T1 = (Q[0], Q[1], one)
-    T2 = dbl(T1, curve)
-    T3 = _madd_w(T2, Q, curve)
-    q_tab = (Pid, T1, T2, T3)
+    q_tab = _q_table_single(Q, curve)
 
     def q_addend(dig):
-        return _select4(dig, q_tab)
+        return select_tree(q_tab, dig)
 
     def g_add(acc, gi):
         q2 = (tab_x[gi].astype(jnp.uint64), tab_y[gi].astype(jnp.uint64))
@@ -729,7 +865,7 @@ def windowed_ladder_single(g_idx, q_digits, Q, gtab,
                      for new_c, acc_c in zip(added, acc))
 
     def q_step(acc, dig):
-        acc = dbl(dbl(acc, curve), curve)
+        acc = dbl(dbl(dbl(dbl(acc, curve), curve), curve), curve)
         return add(acc, q_addend(dig), curve), None
 
     def step(acc, ins):
@@ -766,16 +902,16 @@ _verify_kernel_windowed_single = jax.jit(
 def prepare_batch_windowed_single(curve: WeierstrassCurve, items,
                                   w: int = R1_G_WINDOW):
     """Host prep for the single-scalar windowed kernel: u1 → w-bit G-table
-    indices, u2 → 2-bit Q digits grouped per outer step, Q affine, r + the
-    r+n-valid flag, the device-committed G table (appended before precheck
-    so ``*args, precheck`` callers pass through)."""
+    indices, u2 → 4-bit Q digits (R1_Q_WINDOW) grouped per outer step, Q
+    affine, r + the r+n-valid flag, the device-committed G table (appended
+    before precheck so ``*args, precheck`` callers pass through)."""
     from . import scalarprep as sp
     if w == 16 and curve.name == "secp256r1" and sp.available():
         e_words, r_words, s_words, pub_words = _items_to_words(items)
         (g_idx, q_digits, q_x, q_y, r_limbs, rn_ok,
          precheck) = sp.r1_prep(e_words, r_words, s_words, pub_words)
         return (jnp.asarray(g_idx),
-                jnp.asarray(q_digits.reshape(256 // w, w // 2, len(items))),
+                jnp.asarray(q_digits.reshape(256 // w, w // 4, len(items))),
                 (jnp.asarray(q_x), jnp.asarray(q_y)),
                 jnp.asarray(r_limbs), jnp.asarray(rn_ok),
                 *g_window_table_single_device(curve, w), precheck)
@@ -786,8 +922,9 @@ def _prepare_windowed_single_python(curve: WeierstrassCurve, items,
                                     w: int = R1_G_WINDOW):
     precheck, pubs, u1s, u2s, r0, _ = _precheck_and_scalars(curve, items)
     g_idx = _bits_to_w_windows(F.scalars_to_bits(u1s), w).astype(np.int32)
-    digs = _bits_to_windows(F.scalars_to_bits(u2s)).astype(np.uint8)
-    q_digits = digs.reshape(256 // w, w // 2, *digs.shape[1:])
+    digs = _bits_to_w_windows(F.scalars_to_bits(u2s),
+                              R1_Q_WINDOW).astype(np.uint8)
+    q_digits = digs.reshape(256 // w, w // 4, *digs.shape[1:])
     r_limbs = jnp.asarray(F.to_limbs(r0).astype(np.uint16))
     rn_ok = jnp.asarray(np.asarray(
         [r + curve.n < curve.p for r in r0], dtype=np.uint8))
@@ -831,13 +968,7 @@ def hybrid_ladder_wide(g_idx, q_bits, Qc, Qd, gtab, curve: WeierstrassCurve,
         """qb: (B,) packed joint digit wc | wd<<2 — 4 table-index bits in
         one u8 on the wire (the unpacked (B, 4) bit planes were 4x the
         transfer bytes)."""
-        level = table
-        for j in range(4):                # fold by index bit j (LSB first)
-            b = ((qb >> j) & 1).astype(jnp.bool_)
-            level = [tuple(F.select(b, hi_c, lo_c)
-                           for lo_c, hi_c in zip(lo, hi))
-                     for lo, hi in zip(level[0::2], level[1::2])]
-        return level[0]
+        return select_tree(table, qb)
 
     def g_add(acc, gi):
         """Gather the affine G addend and mixed-add it; identity rows

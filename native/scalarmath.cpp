@@ -367,7 +367,7 @@ inline void write_limbs(u16* out, const u64 v[4]) {
 
 extern "C" {
 
-int sm_version() { return 1; }
+int sm_version() { return 2; }
 
 // Differential-test seam: r = a*b mod m for mod_id in
 // {0: k1 n, 1: k1 p, 2: r1 n, 3: r1 p, 4: ed L, 5: ed P}.
@@ -514,7 +514,7 @@ int sm_k1_prep(int64_t n,
 int sm_r1_prep(int64_t n,
                const u64* e, const u64* rr, const u64* ss, const u64* pub,
                int32_t* g_idx,      // (16, n): w=16 windows of u1
-               u8* q_digits,        // (128, n): 2-bit digits of u2
+               u8* q_digits,        // (64, n): 4-bit digits of u2
                u16* q_x, u16* q_y,  // (n,16)
                u16* r_limbs, u8* rn_ok, u8* precheck,
                u64* work)           // scratch: 3*n*4 words
@@ -577,10 +577,10 @@ int sm_r1_prep(int64_t n,
             g_idx[(int64_t)t * n + i] =
                 (int32_t)((u1[shift / 64] >> (shift % 64)) & 0xFFFF);
         }
-        for (int t = 0; t < 128; ++t) {
-            int shift = 2 * (127 - t);
+        for (int t = 0; t < 64; ++t) {
+            int shift = 4 * (63 - t);
             q_digits[(int64_t)t * n + i] =
-                (u8)((u2[shift / 64] >> (shift % 64)) & 3);
+                (u8)((u2[shift / 64] >> (shift % 64)) & 0xF);
         }
         const u64* r4 = rr + 4 * i;
         u64 rw[4];
