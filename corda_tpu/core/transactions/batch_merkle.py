@@ -26,10 +26,17 @@ import numpy as np
 from ..crypto.merkle import _IncludedLeaf, _Leaf, _Node
 from ..crypto.secure_hash import SecureHash
 
-#: Minimum pairs in a round before it routes to the device kernel: below
-#: this the fixed dispatch cost exceeds the host hash time (a host SHA-256
-#: of 64 bytes is ~0.5us; the device round trip is ~ms through a tunnel).
-DEVICE_CROSSOVER = 256
+#: Minimum pairs in a round before it routes to the device kernel.
+#: MEASURED on the tunneled v5e (BASELINE r5): hashlib does ~1.15M 64-byte
+#: hashes/s on one host core while a device round trip pays the ~140ms
+#: tunnel dispatch floor — breakeven is ~10^5 hashes PER ROUND, far above
+#: any per-transaction tear-off tree (oracle bulk verification of 2048
+#: small proofs ran 30k proofs/s host vs 4.4k via the device).  The host
+#: path is therefore the production default; the device path stays
+#: bit-exact (tests force it with a tiny crossover) for locally-attached
+#: TPU deployments, where the ~ms dispatch floor moves breakeven down to
+#: ~10^3 — pass an explicit ``device_crossover`` there.
+DEVICE_CROSSOVER = 1 << 17
 
 
 def verify_filtered_batch(ftxs, device_crossover: int = DEVICE_CROSSOVER,

@@ -44,41 +44,47 @@ def _rotr(x, n: int):
     return (x >> n) | (x << (32 - n))
 
 
+def _round(st, w_t, k_t):
+    a, b, c, d, e, f, g, h = st
+    s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+    ch = (e & f) ^ (~e & g)
+    t1 = h + s1 + ch + k_t + w_t
+    s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    return (t1 + s0 + maj, a, b, c, d + t1, e, f, g)
+
+
 def compress(state, block):
     """One SHA-256 compression: ``state`` (..., 8) u32, ``block`` (..., 16) u32.
 
-    Both the message schedule (48 steps over a rolling 16-word window) and the
-    64 rounds are `lax.scan`s, so the XLA graph is one-step-sized instead of a
-    64x-unrolled block — compile time drops from minutes to seconds on the
-    deep Merkle kernels, and the batch axis supplies all the parallelism the
-    VPU needs. (`unroll=` on the scans is the knob if a profile ever favors
-    partial unrolling on real hardware.)"""
+    The message schedule is computed ON THE FLY inside the round scan (a
+    16-word rolling window in the scan carry): the earlier two-scan form
+    materialized the full (64, B, ...) schedule as a scan OUTPUT — 128MB
+    of HBM round trips per 512k-lane Merkle level, which made the kernel
+    HBM-bound far below the VPU's hash rate.  Rounds 0-15 consume the
+    block directly; rounds 16-63 extend the window."""
     w_init = jnp.moveaxis(block, -1, 0)  # (16, ...)
+    k = jnp.asarray(_K)
+    init = tuple(state[..., i] for i in range(8))
 
-    def sched(window, _):
-        wm16, wm15, wm7, wm2 = window[0], window[1], window[9], window[14]
+    def round_lo(st, wk):
+        w_t, k_t = wk
+        return _round(st, w_t, k_t), None
+
+    st, _ = jax.lax.scan(round_lo, init, (w_init, k[:16]), unroll=4)
+
+    def round_hi(carry, k_t):
+        st, win = carry
+        wm15, wm2 = win[1], win[14]
         s0 = _rotr(wm15, 7) ^ _rotr(wm15, 18) ^ (wm15 >> 3)
         s1 = _rotr(wm2, 17) ^ _rotr(wm2, 19) ^ (wm2 >> 10)
-        nw = wm16 + s0 + wm7 + s1
-        return jnp.concatenate([window[1:], nw[None]], axis=0), nw
+        nw = win[0] + s0 + win[9] + s1
+        st = _round(st, nw, k_t)
+        win = jnp.concatenate([win[1:], nw[None]], axis=0)
+        return (st, win), None
 
-    _, w_rest = jax.lax.scan(sched, w_init, None, length=48)
-    ws = jnp.concatenate([w_init, w_rest], axis=0)  # (64, ...)
-
-    def round_fn(carry, wk):
-        a, b, c, d, e, f, g, h = carry
-        w_t, k_t = wk
-        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
-        ch = (e & f) ^ (~e & g)
-        t1 = h + s1 + ch + k_t + w_t
-        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
-        maj = (a & b) ^ (a & c) ^ (b & c)
-        t2 = s0 + maj
-        return (t1 + t2, a, b, c, d + t1, e, f, g), None
-
-    init = tuple(state[..., i] for i in range(8))
-    final, _ = jax.lax.scan(round_fn, init, (ws, jnp.asarray(_K)))
-    return state + jnp.stack(final, axis=-1)
+    (st, _), _ = jax.lax.scan(round_hi, (st, w_init), k[16:], unroll=4)
+    return state + jnp.stack(st, axis=-1)
 
 
 @jax.jit
@@ -114,18 +120,17 @@ def hash_pairs(pairs) -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=("n",))
 def _merkle_root_impl(leaves, n: int):
-    """Tree-hash via a fori_loop over levels on a fixed-width buffer: every
-    iteration hashes all n/2 adjacent pairs (lanes beyond the live level are
-    garbage and ignored), so ONE compiled level body serves every tree depth
-    instead of a depth-unrolled graph per leaf count."""
-    levels = n.bit_length() - 1  # log2(n)
-
-    def level_step(_, buf):
-        pairs = buf.reshape(buf.shape[:-2] + (n // 2, 16))
-        hashed = hash_pairs(pairs)
-        return jnp.concatenate([hashed, jnp.zeros_like(hashed)], axis=-2)
-
-    buf = jax.lax.fori_loop(0, levels, level_step, leaves)
+    """Tree-hash with SHRINKING per-level shapes: level k hashes exactly
+    n/2^(k+1) pairs.  The earlier fixed-width fori_loop hashed all n/2
+    lanes at EVERY level (garbage lanes ignored) — one compiled body, but
+    log2(n)·n/2 lane-hashes for n-1 useful ones, measured ~7x wasted VPU
+    work at 16k leaves (BASELINE r5).  Unrolling the levels costs one
+    graph per depth (depths are few and the compile is cached) and does
+    the minimal n-1 hashes."""
+    buf = leaves
+    while buf.shape[-2] > 1:
+        half = buf.shape[-2] // 2
+        buf = hash_pairs(buf.reshape(buf.shape[:-2] + (half, 16)))
     return buf[..., 0, :]
 
 
