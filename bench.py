@@ -161,8 +161,14 @@ def _ed_triples(items):
 def _service_rate_for(batcher, triples) -> float:
     """Median continuous-stream rate over SERVICE_RUNS runs (all reps
     queued up front so batch N+1's host prep overlaps batch N's device
-    round-trip — the service's steady-state shape)."""
-    assert all(batcher.submit_group(triples).result(timeout=900))   # warm
+    round-trip — the service's steady-state shape).  The warm pass queues
+    the SAME depth as the timed loop so every bucket size the drain will
+    produce compiles HERE (fresh bucket kernels cost hundreds of seconds
+    through the tunnel, persistent-cached afterwards) — a shallower warm
+    left the timed loop hitting uncompiled remainder buckets."""
+    warm = [batcher.submit_group(triples) for _ in range(REPS)]
+    for wf in warm:
+        assert all(wf.result(timeout=3000))
     rates = []
     for _ in range(SERVICE_RUNS):
         t0 = time.perf_counter()

@@ -299,7 +299,7 @@ def _joint_a_table(neg_a, neg_a2):
 
 
 def split_ladder(b_idx, b2_idx, a_packed, neg_a, neg_a2, btab, b2tab,
-                 w: int):
+                 w: int):  # noqa: D401 — see verify_core_split for wire form
     """[s_lo]B + [s_hi]B' + [k_lo](−A) + [k_hi](−A') over 128 bits.
 
     ``b_idx``/``b2_idx``: (128/w, B) Niels-table indices for the two
@@ -342,18 +342,29 @@ def split_ladder(b_idx, b2_idx, a_packed, neg_a, neg_a2, btab, b2tab,
     return acc
 
 
-def verify_core_split(b_idx, b2_idx, a_packed, neg_a, neg_a2, r_y, r_sign,
+def verify_core_split(bb_idx, a_packed, rows, r_packed,
                       tab_p, tab_m, tab_td, tab2_p, tab2_m, tab2_td,
                       w: int):
     """Split-k verify: RFC 8032 re-encoding acceptance (see
-    verify_core_windowed) over the half-length ladder."""
-    b_idx = jnp.asarray(b_idx, jnp.int32)
-    b2_idx = jnp.asarray(b2_idx, jnp.int32)
+    verify_core_windowed) over the half-length ladder.
+
+    CONSOLIDATED wire form — 4 per-batch arrays instead of 12: every
+    host→device transfer through the tunnel pays a per-array latency on
+    top of bandwidth, and at 32k the service path was measured
+    transfer-bound, not host- or compute-bound (BASELINE r5).
+    ``bb_idx``: (16, B) i32 = b_idx ‖ b2_idx; ``a_packed``: (8, w/2, B)
+    u8 joint digits; ``rows``: (B, 6, 16) u16 = (−A x, y, t, −A' x, y,
+    t) limb rows; ``r_packed``: (B, 16) u16 wire y with the SIGN bit in
+    limb 15 bit 15 (the y value itself is < 2^255)."""
+    bb_idx = jnp.asarray(bb_idx, jnp.int32)
     a_packed = jnp.asarray(a_packed, jnp.uint64)
-    neg_a = tuple(jnp.asarray(c, jnp.uint64) for c in neg_a)
-    neg_a2 = tuple(jnp.asarray(c, jnp.uint64) for c in neg_a2)
-    r_y = jnp.asarray(r_y, jnp.uint64)
-    r_sign = jnp.asarray(r_sign)
+    rows = jnp.asarray(rows, jnp.uint64)
+    r_packed = jnp.asarray(r_packed, jnp.uint64)
+    b_idx, b2_idx = bb_idx[:8], bb_idx[8:]
+    neg_a = tuple(rows[:, j] for j in range(3))
+    neg_a2 = tuple(rows[:, 3 + j] for j in range(3))
+    r_sign = r_packed[..., 15] >> 15
+    r_y = r_packed.at[..., 15].set(r_packed[..., 15] & 0x7FFF)
     acc = split_ladder(b_idx, b2_idx, a_packed, neg_a, neg_a2,
                        (tab_p, tab_m, tab_td), (tab2_p, tab2_m, tab2_td), w)
     x, y, z, _ = acc
@@ -556,8 +567,8 @@ def prepare_batch_split(items: list[tuple[bytes, bytes, bytes]],
     the _signer_row cache, SHA-512 challenges via hashlib, and the scalar
     windows from native scalarmath (Python-bigint fallback below).
 
-    Returns (b_idx, b2_idx, a_packed, neg_a, neg_a2, r_y, r_sign,
-    [tables...], precheck)."""
+    Returns (bb_idx, a_packed, rows, r_packed, [tables...], precheck) —
+    the consolidated 4-array wire form of verify_core_split."""
     from . import scalarprep as sp
     assert w == 16, "split prep emits 16-bit constant-base windows"
     n = len(items)
@@ -576,13 +587,13 @@ def prepare_batch_split(items: list[tuple[bytes, bytes, bytes]],
             rows[i] = row
             sig_mat[i] = np.frombuffer(sig, dtype=np.uint8)
             digests.append(hashlib.sha512(sig[:32] + pub + msg).digest())
-    r_limbs = sig_mat[:, :32].copy().view("<u2")        # (n, 16) wire y
-    r_sign = (r_limbs[:, 15] >> 15).astype(np.uint8)
-    r_y = r_limbs.copy()
-    r_y[:, 15] &= 0x7FFF
+    r_packed = sig_mat[:, :32].copy().view("<u2")       # (n, 16) wire y
+    # the wire sign bit stays IN limb 15 bit 15 (the kernel unpacks it);
+    # range checks use the masked view
+    y15 = r_packed[:, 15] & 0x7FFF
     # non-canonical y (>= p = 2^255-19) rejects like a failed decompression
-    ge_p = ((r_y[:, 0] >= 0xFFED) & (r_y[:, 15] == 0x7FFF)
-            & (r_y[:, 1:15] == 0xFFFF).all(axis=1))
+    ge_p = ((r_packed[:, 0] >= 0xFFED) & (y15 == 0x7FFF)
+            & (r_packed[:, 1:15] == 0xFFFF).all(axis=1))
     precheck &= ~ge_p
     s_words = sig_mat[:, 32:].copy().view("<u8")        # (n, 4)
     if sp.available():
@@ -593,12 +604,9 @@ def prepare_batch_split(items: list[tuple[bytes, bytes, bytes]],
             digests, s_words)
     precheck &= s_ok
     a_digits = a_packed.reshape(128 // w, w // 2, n)
-    head = (jnp.asarray(b_idx), jnp.asarray(b2_idx), jnp.asarray(a_digits),
-            tuple(jnp.asarray(np.ascontiguousarray(rows[:, j]))
-                  for j in range(3)),
-            tuple(jnp.asarray(np.ascontiguousarray(rows[:, 3 + j]))
-                  for j in range(3)),
-            jnp.asarray(r_y), jnp.asarray(r_sign))
+    head = (jnp.asarray(np.concatenate([b_idx, b2_idx])),
+            jnp.asarray(a_digits), jnp.asarray(rows),
+            jnp.asarray(r_packed))
     if device_tables:
         return (*head, *b_table_device(w, 0), *b_table_device(w, 128),
                 precheck)

@@ -12,7 +12,6 @@ Bit-exact against hashlib (differentially tested in tests/test_ops_sha256.py).
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -118,15 +117,15 @@ def hash_pairs(pairs) -> jax.Array:
     return compress(state, pad)
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _merkle_root_impl(leaves, n: int):
+@jax.jit
+def _merkle_root_impl(leaves):
     """Tree-hash with SHRINKING per-level shapes: level k hashes exactly
     n/2^(k+1) pairs.  The earlier fixed-width fori_loop hashed all n/2
     lanes at EVERY level (garbage lanes ignored) — one compiled body, but
     log2(n)·n/2 lane-hashes for n-1 useful ones, measured ~7x wasted VPU
     work at 16k leaves (BASELINE r5).  Unrolling the levels costs one
-    graph per depth (depths are few and the compile is cached) and does
-    the minimal n-1 hashes."""
+    graph per depth (jit specializes on the leaf shape; compiles are
+    cached) and does the minimal n-1 hashes."""
     buf = leaves
     while buf.shape[-2] > 1:
         half = buf.shape[-2] // 2
@@ -143,7 +142,7 @@ def merkle_root(leaves) -> jax.Array:
         raise ValueError("merkle_root requires a power-of-two leaf count (zero-pad)")
     if n == 1:
         return leaves[..., 0, :]
-    return _merkle_root_impl(leaves, n)
+    return _merkle_root_impl(leaves)
 
 
 # ---------------------------------------------------------------------------

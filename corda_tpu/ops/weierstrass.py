@@ -1006,14 +1006,22 @@ def hybrid_ladder_wide(g_idx, q_bits, Qc, Qd, gtab, curve: WeierstrassCurve,
     return acc
 
 
-def verify_core_hybrid_wide(g_idx, q_bits, Qc, Qd, r_limbs, rn_ok,
+def verify_core_hybrid_wide(g_idx, q_bits, pts, r_limbs,
                             tab_x, tab_y, tab_ok, g_w: int):
+    """CONSOLIDATED wire form — 4 per-batch arrays instead of 8 (each
+    host→device transfer pays per-array tunnel latency; the service path
+    is transfer-bound — BASELINE r5): ``g_idx`` (W_g, B) i32 with the
+    rn_ok flag packed at BIT 18 of row 0 (indices use 2·g_w+2 = 18
+    bits); ``pts`` (B, 4, 16) u16 = (Qc_x, Qc_y, Qd_x, Qd_y) limb rows;
+    ``q_bits``/``r_limbs`` as before."""
     g_idx = jnp.asarray(g_idx, jnp.int32)
     q_bits = jnp.asarray(q_bits, jnp.uint64)
-    Qc = tuple(jnp.asarray(c, jnp.uint64) for c in Qc)
-    Qd = tuple(jnp.asarray(c, jnp.uint64) for c in Qd)
+    pts = jnp.asarray(pts, jnp.uint64)
     r_limbs = jnp.asarray(r_limbs, jnp.uint64)
-    rn_ok = jnp.asarray(rn_ok).astype(jnp.bool_)
+    rn_ok = ((g_idx[0] >> 18) & 1).astype(jnp.bool_)
+    g_idx = g_idx & ((1 << (2 * g_w + 2)) - 1)
+    Qc = (pts[:, 0], pts[:, 1])
+    Qd = (pts[:, 2], pts[:, 3])
     curve = CURVES["secp256k1"]
     X, Y, Z = hybrid_ladder_wide(g_idx, q_bits, Qc, Qd,
                                  (tab_x, tab_y, tab_ok), curve, g_w)
@@ -1078,10 +1086,10 @@ def _prepare_hybrid_native(items, g_w: int):
      rn_ok, precheck) = sp.k1_prep(e_words, r_words, s_words, pub_words)
     n_g = 128 // g_w
     q_bits = q_packed.reshape(n_g, g_w // 2, len(items))
-    return (jnp.asarray(g_idx), jnp.asarray(q_bits),
-            (jnp.asarray(qc_x), jnp.asarray(qc_y)),
-            (jnp.asarray(qd_x), jnp.asarray(qd_y)),
-            jnp.asarray(r_limbs), jnp.asarray(rn_ok),
+    g_idx[0] |= rn_ok.astype(np.int32) << 18      # consolidated wire form
+    pts = np.stack([qc_x, qc_y, qd_x, qd_y], axis=1)     # (B, 4, 16)
+    return (jnp.asarray(g_idx), jnp.asarray(q_bits), jnp.asarray(pts),
+            jnp.asarray(r_limbs),
             *g_window_table_device(curve, g_w), precheck)
 
 
@@ -1095,6 +1103,11 @@ def prepare_batch_hybrid_wide(items, g_w: int):
     available — bit-identical outputs (tests/test_scalarprep.py)."""
     if g_w % 2 or g_w < 2:
         raise ValueError(f"g_w must be even and >= 2, got {g_w}")
+    if 2 * g_w + 2 > 18:
+        # the consolidated wire form packs rn_ok at g_idx bit 18, above
+        # the widest supported index (2·g_w+2 bits); a wider window would
+        # silently corrupt a digit bit
+        raise ValueError(f"g_w {g_w} exceeds the packed-index budget")
     from . import scalarprep as sp
     if g_w == 8 and sp.available():
         return _prepare_hybrid_native(items, g_w)
@@ -1133,11 +1146,15 @@ def _prepare_hybrid_python(items, g_w: int):
     n_g = nbits // g_w
     q_bits = q_packed.reshape(n_g, g_w // 2, *q_packed.shape[1:])
     r_limbs = jnp.asarray(F.to_limbs(r0).astype(np.uint16))
-    rn_ok = jnp.asarray(np.asarray(
-        [r + curve.n < curve.p for r in r0], dtype=np.uint8))
-    return (jnp.asarray(g_idx), jnp.asarray(q_bits),
-            _points_to_limbs_affine(qc_pts), _points_to_limbs_affine(qd_pts),
-            r_limbs, rn_ok, *g_window_table_device(curve, g_w), precheck)
+    rn_ok = np.asarray([r + curve.n < curve.p for r in r0], dtype=np.int32)
+    g_idx = g_idx.astype(np.int32)
+    g_idx[0] |= rn_ok << 18                       # consolidated wire form
+    pts = np.stack([F.to_limbs(xs_).astype(np.uint16)
+                    for col in (qc_pts, qd_pts)
+                    for xs_ in ([p_[0] for p_ in col],
+                                [p_[1] for p_ in col])], axis=1)
+    return (jnp.asarray(g_idx), jnp.asarray(q_bits), jnp.asarray(pts),
+            r_limbs, *g_window_table_device(curve, g_w), precheck)
 
 
 def verify_core(u1_bits, u2_bits, q_pts, r_cands, curve_name: str):

@@ -89,16 +89,15 @@ def sharded_ed25519_verify_split(mesh: Mesh):
     the same dp way: both Niels tables (B and [2^128]B) replicated per
     chip, batch axis sharded.
 
-    Input layout (from ops.ed25519.prepare_batch_split): b_idx/b2_idx
-    (128/w, B); a_packed (128/w, w/2, B); neg_a/neg_a2 affine 3×(B, 16);
-    r_y (B, 16); r_sign (B,); six replicated table arrays."""
+    Input layout (from ops.ed25519.prepare_batch_split — the consolidated
+    4-array wire form): bb_idx (16, B); a_packed (8, w/2, B); rows
+    (B, 6, 16); r_packed (B, 16); six replicated table arrays."""
     core = functools.partial(ed_ops.verify_core_split,
                              w=ed_ops.SPLIT_B_WINDOW)
     shmapped = jax.shard_map(
         core, mesh=mesh,
-        in_specs=(P(None, AXIS), P(None, AXIS), P(None, None, AXIS),
-                  (P(AXIS, None),) * 3, (P(AXIS, None),) * 3,
-                  P(AXIS, None), P(AXIS),
+        in_specs=(P(None, AXIS), P(None, None, AXIS),
+                  P(AXIS, None, None), P(AXIS, None),
                   *((P(None, None),) * 6)),
         out_specs=P(AXIS),
         check_vma=False)  # see sharded_ed25519_verify
@@ -127,17 +126,17 @@ def sharded_ecdsa_verify_hybrid(mesh: Mesh):
     default wide-G window — the fastest single-chip path
     (ops.weierstrass.verify_core_hybrid_wide), scaled the same dp way.
 
-    Input layout (from ops.weierstrass.prepare_batch_hybrid_wide): g_idx
-    (W_g, B); q_bits (W_g, g_w/2, B) packed digits; Qc/Qd affine 2×(B, 16);
-    r (B, 16); rn_ok (B,); the constant-G table replicated on every chip.
+    Input layout (from ops.weierstrass.prepare_batch_hybrid_wide — the
+    consolidated 4-array wire form): g_idx (W_g, B) with rn_ok at bit 18
+    of row 0; q_bits (W_g, g_w/2, B) packed digits; pts (B, 4, 16);
+    r (B, 16); the constant-G table replicated on every chip.
     """
     core = functools.partial(wc_ops.verify_core_hybrid_wide,
                              g_w=wc_ops.HYBRID_G_WINDOW)
     shmapped = jax.shard_map(
         core, mesh=mesh,
         in_specs=(P(None, AXIS), P(None, None, AXIS),
-                  (P(AXIS, None),) * 2, (P(AXIS, None),) * 2,
-                  P(AXIS, None), P(AXIS),
+                  P(AXIS, None, None), P(AXIS, None),
                   P(None, None), P(None, None), P(None)),
         out_specs=P(AXIS),
         check_vma=False)  # see sharded_ed25519_verify
