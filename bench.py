@@ -184,6 +184,8 @@ def service_metrics(k1_items, ed_items, r1_items):
     device kernel + future resolution — what a node actually gets): k1,
     ed25519, and a mixed-scheme stream; p50 @ batch=1 and @ batch=1k."""
     from corda_tpu.core.crypto.schemes import ECDSA_SECP256R1_SHA256
+    from corda_tpu.observability import stage_percentiles
+    from corda_tpu.utils.metrics import MetricRegistry
     from corda_tpu.verifier.batcher import SignatureBatcher
 
     k1_triples = _k1_triples(k1_items)
@@ -197,7 +199,8 @@ def service_metrics(k1_items, ed_items, r1_items):
         ecmath.SECP256R1, ECDSA_SECP256R1_SHA256)
     mixed = (ed_triples[: int(0.45 * n)] + k1_triples[: int(0.45 * n)]
              + r1_triples)
-    batcher = SignatureBatcher()
+    registry = MetricRegistry()
+    batcher = SignatureBatcher(metrics=registry)
     try:
         k1_rate = _service_rate_for(batcher, k1_triples)
         ed_rate = _service_rate_for(batcher, ed_triples)
@@ -223,7 +226,10 @@ def service_metrics(k1_items, ed_items, r1_items):
         p50_1k_ms = sorted(mid)[len(mid) // 2] * 1000.0
     finally:
         batcher.close()
-    return k1_rate, ed_rate, mixed_rate, p50_ms, p50_1k_ms
+    # per-stage latency breakdown (prep / dispatch / finish percentiles)
+    # from the batcher's histograms — where a verify's time actually went
+    stages = stage_percentiles(registry.snapshot())
+    return k1_rate, ed_rate, mixed_rate, p50_ms, p50_1k_ms, stages
 
 
 def main() -> None:
@@ -233,7 +239,7 @@ def main() -> None:
     dev = device_rate(items)
     ed_dev = ed_device_rate(ed_items)
     r1_dev = r1_device_rate(r1_items)
-    k1_rate, ed_rate, mixed_rate, p50_ms, p50_1k_ms = service_metrics(
+    k1_rate, ed_rate, mixed_rate, p50_ms, p50_1k_ms, stages = service_metrics(
         items, ed_items, r1_items)
     host = host_baseline_rate(items[: min(128, BATCH)])
     print(json.dumps({
@@ -250,6 +256,7 @@ def main() -> None:
         "tx_verify_p50_ms_batch1k": round(p50_1k_ms, 3),
         "host_baseline_verifies_per_sec": round(host, 1),
         "unique_signatures": UNIQUE,
+        **stages,
     }))
 
 

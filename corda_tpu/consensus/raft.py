@@ -247,15 +247,23 @@ class RaftNode:
             self.state.term_at(prev), entries, self.state.commit_index))
 
     # -- client submission ---------------------------------------------------
-    def submit(self, entry) -> Future:
+    def submit(self, entry, trace_ctx=None) -> Future:
         """Replicate `entry`; the future resolves with apply_fn's result once
         committed. On a follower, forwards to the known leader. The caller
         owns the timeout: call `abandon(fut)` if it gives up waiting, so the
-        pending-request table cannot leak."""
+        pending-request table cannot leak. ``trace_ctx`` parents a
+        "raft.submit" span covering submission → commit/apply (finished when
+        the response resolves the future)."""
+        from ..observability import get_tracer
+        tracer = get_tracer()
         with self._lock:
             fut: Future = Future()
             rid = next(self._request_ids)
             fut.raft_request_id = rid
+            if tracer.enabled:
+                fut.raft_trace_span = tracer.span(
+                    "raft.submit", parent=trace_ctx, node=self.node_id,
+                    role=self.role, request_id=rid)
             self._pending[rid] = fut
             req = ClientRequest(rid, self.node_id, entry)
             if self.role == LEADER:
@@ -264,6 +272,10 @@ class RaftNode:
                 self._post(self.leader_id, req)
             else:
                 self._pending.pop(rid)
+                span = getattr(fut, "raft_trace_span", None)
+                if span is not None:
+                    span.set_tag("error", "no raft leader known")
+                    span.finish()
                 fut.set_exception(RuntimeError("no raft leader known"))
             return fut
 
@@ -436,6 +448,11 @@ class RaftNode:
         fut = self._pending.pop(m.request_id, None)
         if fut is None:
             return
+        span = getattr(fut, "raft_trace_span", None)
+        if span is not None:
+            if m.error is not None:
+                span.set_tag("error", m.error)
+            span.finish()
         if m.error is not None:
             fut.set_exception(RaftApplyError(m.error))
         else:

@@ -325,6 +325,16 @@ def from_wire(wire: Any) -> Any:
                 raise SerializationError(
                     f"Schema'd object {name!r}: {len(field_names)} names "
                     f"vs {len(fields)} fields")
+            if len(set(field_names)) != len(field_names):
+                # a duplicated name is always hostile/corrupt wire: binding
+                # would silently keep only the last value (dict semantics in
+                # both the by-name rebind and the carpenter kwargs)
+                seen: set = set()
+                dupes = sorted({fn for fn in field_names
+                                if fn in seen or seen.add(fn)})
+                raise SerializationError(
+                    f"Schema'd object {name!r}: duplicate field names "
+                    f"{dupes}")
             entry = _REGISTRY.get(name)
             if entry is not None:       # the real class is known: it wins
                 cls, _, from_fields = entry
@@ -389,10 +399,12 @@ def _evolved_decode(name: str, cls, local: list[str], field_names, fields):
             vals.append(_freeze(by_name[n]))
             continue
         f = spec[n]
+        # defaults freeze like carried values do (a list default becomes a
+        # tuple): evolved instances must hash/compare like native ones
         if f.default is not dataclasses.MISSING:
-            vals.append(f.default)
+            vals.append(_freeze(f.default))
         elif f.default_factory is not dataclasses.MISSING:
-            vals.append(f.default_factory())
+            vals.append(_freeze(f.default_factory()))
         else:
             raise SerializationError(
                 f"Schema'd object {name!r}: peer version lacks field "

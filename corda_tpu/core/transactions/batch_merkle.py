@@ -38,6 +38,64 @@ from ..crypto.secure_hash import SecureHash
 #: ~10^3 — pass an explicit ``device_crossover`` there.
 DEVICE_CROSSOVER = 1 << 17
 
+#: Hard depth cap on a partial tree walk.  A genuine proof over K
+#: components is ~log2(K) deep (depth 64 covers 10^19 leaves); anything
+#:  deeper is a hostile/corrupt structure built to exhaust the verifier.
+#: The traversal is ITERATIVE, so a deep chain can't blow the Python
+#: recursion limit — the cap just bounds the work and marks that one
+#: member False while the rest of the batch verifies normally.
+MAX_PROOF_DEPTH = 512
+
+
+def _walk_partial_tree(root, values: dict, rounds: list,
+                       included: list) -> bool:
+    """Iterative post-order walk of one ftx's partial tree into ``values``
+    (node id → hash bytes for resolved nodes) and ``rounds`` (internal
+    nodes grouped by depth).  Returns False — leaving the caller's dicts
+    untouched — on a malformed node type or a tree deeper than
+    ``MAX_PROOF_DEPTH``."""
+    local_values: dict[int, bytes] = {}
+    local_rounds: list[list[_Node]] = []
+    local_included: list[bytes] = []
+    depth_of: dict[int, int] = {}
+    stack: list[tuple] = [(root, False)]
+    while stack:
+        # a left-leaning chain holds ~its depth in unvisited frames; bail
+        # before a hostile 10^6-node path burns CPU on a doomed proof
+        if len(stack) > 2 * MAX_PROOF_DEPTH + 2:
+            return False
+        node, visited = stack.pop()
+        if isinstance(node, _IncludedLeaf):
+            local_values[id(node)] = node.hash.bytes
+            local_included.append(node.hash.bytes)
+            depth_of[id(node)] = 0
+        elif isinstance(node, _Leaf):
+            local_values[id(node)] = node.hash.bytes
+            depth_of[id(node)] = 0
+        elif isinstance(node, _Node):
+            if not visited:
+                stack.append((node, True))
+                stack.append((node.right, False))
+                stack.append((node.left, False))
+            else:
+                d = max(depth_of[id(node.left)],
+                        depth_of[id(node.right)]) + 1
+                if d > MAX_PROOF_DEPTH:
+                    return False
+                while len(local_rounds) < d:
+                    local_rounds.append([])
+                local_rounds[d - 1].append(node)
+                depth_of[id(node)] = d
+        else:
+            return False   # not a partial-tree node at all
+    values.update(local_values)
+    while len(rounds) < len(local_rounds):
+        rounds.append([])
+    for i, rnd in enumerate(local_rounds):
+        rounds[i].extend(rnd)
+    included.extend(local_included)
+    return True
+
 
 def verify_filtered_batch(ftxs, device_crossover: int = DEVICE_CROSSOVER,
                           use_device: bool = True) -> list[bool]:
@@ -47,32 +105,22 @@ def verify_filtered_batch(ftxs, device_crossover: int = DEVICE_CROSSOVER,
     ``root_hash`` AND the included leaves are exactly the revealed
     components (the same two checks as ``FilteredTransaction.verify``).
     An ftx with no revealed components verifies False (the single-item
-    API raises ValueError there; a batch must not let one malformed
-    member abort the rest — the per-item-isolation rule of
-    verifier/batcher.py)."""
+    API raises ValueError there), as does one whose partial tree is
+    malformed or hostile-deep (``MAX_PROOF_DEPTH``) — a batch must not
+    let one malformed member abort the rest (the per-item-isolation rule
+    of verifier/batcher.py)."""
     values: dict[int, bytes] = {}
     rounds: list[list[_Node]] = []
     per_ftx: list[tuple] = []
 
-    def walk(node, included: list[bytes]) -> int:
-        if isinstance(node, _IncludedLeaf):
-            values[id(node)] = node.hash.bytes
-            included.append(node.hash.bytes)
-            return 0
-        if isinstance(node, _Leaf):
-            values[id(node)] = node.hash.bytes
-            return 0
-        d = max(walk(node.left, included), walk(node.right, included)) + 1
-        while len(rounds) < d:
-            rounds.append([])
-        rounds[d - 1].append(node)
-        return d
-
     for ftx in ftxs:
         included: list[bytes] = []
-        root = ftx.partial_merkle_tree.root
-        walk(root, included)
-        per_ftx.append((root, included))
+        try:
+            root = ftx.partial_merkle_tree.root
+            ok = _walk_partial_tree(root, values, rounds, included)
+        except Exception:
+            root, ok = None, False
+        per_ftx.append((root, included) if ok else (None, included))
 
     for rnd in rounds:
         pairs = b"".join(values[id(n.left)] + values[id(n.right)]
@@ -90,11 +138,17 @@ def verify_filtered_batch(ftxs, device_crossover: int = DEVICE_CROSSOVER,
 
     verdicts = []
     for ftx, (root, included) in zip(ftxs, per_ftx):
-        want = {h.bytes for h in
-                ftx.filtered_leaves.available_component_hashes}
-        verdicts.append(bool(want)
-                        and values[id(root)] == ftx.root_hash.bytes
-                        and set(included) == want)
+        if root is None:   # walk rejected it (malformed / too deep)
+            verdicts.append(False)
+            continue
+        try:
+            want = {h.bytes for h in
+                    ftx.filtered_leaves.available_component_hashes}
+            verdicts.append(bool(want)
+                            and values[id(root)] == ftx.root_hash.bytes
+                            and set(included) == want)
+        except Exception:
+            verdicts.append(False)
     return verdicts
 
 

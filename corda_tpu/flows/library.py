@@ -116,7 +116,12 @@ class NotaryServiceFlow(FlowLogic):
         if not self.service.time_window_checker.is_valid(stx.tx.time_window):
             raise FlowException("Transaction time-window is outside tolerance")
         try:
-            self.service.commit(stx.inputs, stx.id, str(self.peer.name))
+            if getattr(self.service, "supports_trace_ctx", False):
+                self.service.commit(
+                    stx.inputs, stx.id, str(self.peer.name),
+                    trace_ctx=getattr(self.state_machine, "trace_ctx", None))
+            else:
+                self.service.commit(stx.inputs, stx.id, str(self.peer.name))
         except Exception as e:
             raise FlowException(str(e)) from e
         sig = self.service.sign_tx_id(stx.id)

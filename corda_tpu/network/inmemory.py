@@ -104,6 +104,8 @@ class InMemoryMessagingNetwork:
 class InMemoryMessaging(MessagingService):
     """One endpoint on the bus (a node's MessagingService)."""
 
+    supports_trace = True
+
     def __init__(self, network: InMemoryMessagingNetwork, name: str):
         self._network = network
         self._name = name
@@ -117,8 +119,8 @@ class InMemoryMessaging(MessagingService):
         return self._name
 
     def send(self, topic_session: TopicSession, payload: bytes,
-             recipient: str) -> None:
-        msg = Message(topic_session, payload, sender=self._name)
+             recipient: str, trace: tuple | None = None) -> None:
+        msg = Message(topic_session, payload, sender=self._name, trace=trace)
         self._network._enqueue(self._name, recipient, msg)
 
     def add_message_handler(self, topic_session: TopicSession, callback

@@ -45,6 +45,9 @@ class Message:
     data: bytes
     unique_id: int = field(default_factory=lambda: next(_uid))
     sender: str | None = None  # peer name, filled by the transport
+    # (trace_id, span_id) of the sending flow's span, when the transport
+    # propagates traces (observability.tracing) — None otherwise
+    trace: tuple | None = None
 
 
 @dataclass(frozen=True)
@@ -55,6 +58,11 @@ class MessageHandlerRegistration:
 
 class MessagingService:
     """Transport-independent messaging SPI (Messaging.kt:1-230)."""
+
+    #: transports that carry Message.trace across the wire flip this on;
+    #: senders probe it before passing the trace kwarg, so third-party
+    #: transports with the original send() signature keep working
+    supports_trace = False
 
     def send(self, topic_session: TopicSession, payload: bytes,
              recipient: str) -> None:

@@ -154,8 +154,18 @@ class NotaryService:
             flow_name(NotaryFlow),
             lambda peer: NotaryServiceFlow(peer, self))
 
-    def commit(self, input_refs, tx_id, caller_name: str) -> None:
-        self.uniqueness.commit(list(input_refs), tx_id, caller_name)
+    #: probe-able capability flag (same pattern as the verifier service's
+    #: supports_trace_ctx): callers may pass their span context through
+    supports_trace_ctx = True
+
+    def commit(self, input_refs, tx_id, caller_name: str,
+               trace_ctx=None) -> None:
+        from ..observability import get_tracer
+        refs = list(input_refs)
+        with get_tracer().span("notary.commit", parent=trace_ctx,
+                               tx_id=tx_id.bytes.hex()[:16],
+                               n_inputs=len(refs), caller=caller_name):
+            self.uniqueness.commit(refs, tx_id, caller_name)
 
     def sign_tx_id(self, tx_id):
         return self.hub.sign(tx_id.bytes)

@@ -29,6 +29,7 @@ from ..flows.api import (ExecuteOnce, FlowException, FlowLogic, FlowSession,
                          WaitForLedgerCommit, flow_name,
                          get_initiated_flow_factory)
 from ..network.messaging import TOPIC_P2P, TopicSession
+from ..observability import get_tracer
 from .checkpoints import Checkpoint, CheckpointStorage, SessionSnapshot
 
 
@@ -102,6 +103,10 @@ class FlowStateMachine:
         self.parked_group: int = 0       # session group active at park time
         self.result_future: Future = Future()
         self.done = False
+        # observability: the flow's root span (opened in _register, closed in
+        # _finalize); trace_ctx rides into verifier submits and P2P sends
+        self.trace_span = None
+        self.trace_ctx = None
 
     @property
     def current_group(self) -> tuple[int, str]:
@@ -232,6 +237,12 @@ class StateMachineManager:
             audit.record_audit_event(FlowStartEvent(
                 description="flow started",
                 flow_type=flow_name(type(fsm.flow)), flow_id=fsm.run_id))
+        tracer = get_tracer()
+        if tracer.enabled and fsm.trace_span is None:
+            fsm.trace_span = tracer.span(
+                "flow.run", parent=fsm.trace_ctx,
+                flow_type=flow_name(type(fsm.flow)), flow_id=fsm.run_id)
+            fsm.trace_ctx = fsm.trace_span.context()
         self.flows[fsm.run_id] = fsm
         fsm.flow.state_machine = fsm
         fsm.flow.service_hub = self.hub
@@ -447,9 +458,13 @@ class StateMachineManager:
                 # catch SignatureException and recover), not routed to _fail
                 return self._log(fsm, ("error", _error_payload(e)))
             return self._log(fsm, ("value", None))
+        kwargs = {}
+        if getattr(svc, "supports_trace_ctx", False) and fsm.trace_ctx is not None:
+            kwargs["trace_ctx"] = fsm.trace_ctx
         fut = svc.verify_signed(
             request.stx, self.hub,
-            check_sufficient_signatures=request.check_sufficient_signatures)
+            check_sufficient_signatures=request.check_sufficient_signatures,
+            **kwargs)
         self._awaiting_external += 1
         fut.add_done_callback(
             lambda f: self._post_external(
@@ -566,8 +581,21 @@ class StateMachineManager:
         self._post(party, SessionData(sess.peer_session_id, payload))
 
     def _post(self, party, message) -> None:
-        self.hub.network_service.send(
-            TopicSession(TOPIC_P2P), serialize(message), str(party.name))
+        svc = self.hub.network_service
+        fsm = self.current_fsm
+        if getattr(svc, "supports_trace", False) and fsm is not None \
+                and fsm.trace_ctx is not None:
+            ctx = fsm.trace_ctx
+            # ctx is a SpanContext once _register ran under a live tracer,
+            # but may still be the raw wire tuple of an initiating message
+            ids = ctx if isinstance(ctx, tuple) else (ctx.trace_id, ctx.span_id)
+            get_tracer().record(
+                "session.send", parent=ctx, peer=str(party.name),
+                kind=type(message).__name__)
+            svc.send(TopicSession(TOPIC_P2P), serialize(message),
+                     str(party.name), trace=ids)
+            return
+        svc.send(TopicSession(TOPIC_P2P), serialize(message), str(party.name))
 
     def on_peer_unreachable(self, peer_name: str) -> None:
         """Transport-level delivery failure (the TCP plane's
@@ -588,8 +616,13 @@ class StateMachineManager:
     # -- inbound dispatch (onSessionMessage, StateMachineManager.kt:307+) ----
     def _on_message(self, msg) -> None:
         sm = deserialize(msg.data)
+        trace = getattr(msg, "trace", None)
+        if trace is not None:
+            get_tracer().record("session.receive", parent=trace,
+                                sender=str(getattr(msg, "sender", None)),
+                                kind=type(sm).__name__)
         if isinstance(sm, SessionInit):
-            self._on_session_init(sm)
+            self._on_session_init(sm, trace=trace)
             return
         if isinstance(sm, SessionConfirm):
             entry = self._session_index.get(sm.initiator_session_id)
@@ -663,7 +696,8 @@ class StateMachineManager:
         if sess is not None:
             self._session_index.pop(sess.our_session_id, None)
 
-    def _on_session_init(self, init: SessionInit) -> None:
+    def _on_session_init(self, init: SessionInit,
+                         trace: tuple | None = None) -> None:
         factory = (self.flow_factories.get(init.flow_name)
                    or get_initiated_flow_factory(init.flow_name))
         peer = self.hub.well_known_party(init.initiator_party)
@@ -676,6 +710,9 @@ class StateMachineManager:
             return
         flow = factory(peer)
         fsm = FlowStateMachine(uuid.uuid4().hex, flow, self)
+        # the responder flow's span joins the initiator's trace — the wire
+        # carried (trace_id, span_id), so the whole P2P exchange is one trace
+        fsm.trace_ctx = trace
         self._register(fsm)
         sess = FlowSession(peer=peer,
                            peer_session_id=init.initiator_session_id,
@@ -720,6 +757,9 @@ class StateMachineManager:
         self._notify("remove", fsm)
 
     def _finalize(self, fsm: FlowStateMachine) -> None:
+        if fsm.trace_span is not None:
+            fsm.trace_span.finish()
+            fsm.trace_span = None
         monitoring = getattr(self.hub, "monitoring", None)
         if monitoring is not None and fsm.run_id in self.flows:
             monitoring.meter("Flows.Finished").mark()

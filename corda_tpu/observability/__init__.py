@@ -1,0 +1,27 @@
+"""End-to-end observability for the TPU verification pipeline.
+
+Three pieces (docs/OBSERVABILITY.md):
+
+- tracing.py — span tracer with explicit SpanContext propagation across
+  the flow state machine, verifier service, SignatureBatcher threads,
+  messaging, notary, and raft. No-op by default (``NOOP_TRACER``);
+  ``enable_tracing()`` turns it on.
+- ring.py — the bounded in-memory span buffer behind a live tracer, with
+  JSONL export and the /traces endpoint's query surface.
+- stages.py — per-stage (prep/dispatch/finish) percentile flattening for
+  bench.py's JSON artifact.
+
+The Histogram metric type itself lives in utils/metrics.py with the rest
+of the registry.
+"""
+from .ring import SpanRing
+from .stages import STAGE_METRICS, stage_percentiles
+from .tracing import (NOOP_SPAN, NOOP_TRACER, NoopTracer, Span, SpanContext,
+                      Tracer, disable_tracing, enable_tracing, get_tracer,
+                      set_tracer)
+
+__all__ = [
+    "NOOP_SPAN", "NOOP_TRACER", "NoopTracer", "Span", "SpanContext",
+    "SpanRing", "STAGE_METRICS", "Tracer", "disable_tracing",
+    "enable_tracing", "get_tracer", "set_tracer", "stage_percentiles",
+]

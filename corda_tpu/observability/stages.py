@@ -1,0 +1,48 @@
+"""Per-stage latency breakdown helpers for the verification pipeline.
+
+The SignatureBatcher records one Histogram (utils/metrics.py) per pipeline
+stage; this module names the stages and flattens a metrics snapshot into
+the flat percentile fields bench.py emits alongside its throughput numbers
+(driver-parseable JSON, same artifact).
+
+Stage model (verifier/batcher.py):
+- ``prep``     host-side batch preparation (decompress keys, pack arrays)
+               up to the async device launch — ``verifier_prep_seconds``
+- ``dispatch`` the device round trip (kernel execution + transfers), or
+               the host verify loop on the host route —
+               ``verifier_dispatch_seconds``
+- ``finish``   future/group resolution fan-out — ``verifier_finish_seconds``
+
+plus ``verifier_batch_size`` (items per flush) and ``tx_verify_seconds``
+(whole-transaction verify, verifier/service.py).
+"""
+from __future__ import annotations
+
+#: stage name -> histogram metric name (the batcher's registry keys)
+STAGE_METRICS = {
+    "prep": "verifier_prep_seconds",
+    "dispatch": "verifier_dispatch_seconds",
+    "finish": "verifier_finish_seconds",
+}
+
+_QUANTS = ("p50", "p90", "p99")
+
+
+def stage_percentiles(snapshot: dict) -> dict:
+    """Flatten a MetricRegistry snapshot into bench-output fields:
+    ``stage_<stage>_ms_<q>`` per present stage histogram, plus
+    ``verifier_batch_size_<q>`` when the batch-size histogram exists.
+    Stages with no samples (e.g. ``prep`` on a host-only run) are omitted —
+    absent keys mean "stage never ran", not zero latency."""
+    out: dict = {}
+    for stage, metric in STAGE_METRICS.items():
+        fields = snapshot.get(metric)
+        if not fields or not fields.get("count"):
+            continue
+        for q in _QUANTS:
+            out[f"stage_{stage}_ms_{q}"] = round(fields[q] * 1000.0, 4)
+    sizes = snapshot.get("verifier_batch_size")
+    if sizes and sizes.get("count"):
+        for q in _QUANTS:
+            out[f"verifier_batch_size_{q}"] = round(sizes[q], 1)
+    return out

@@ -136,6 +136,15 @@ class NodeWebServer:
                     except Exception as e:
                         self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
+                if self.path == "/traces" or self.path.startswith("/traces?"):
+                    try:
+                        ctype, body = server.handle_traces(self.path)
+                        self._reply_raw(200, ctype, body)
+                    except ValueError as e:
+                        self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                    except Exception as e:
+                        self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
                 try:
                     self._reply(200, server.handle_get(self.path))
                 except RouteNotFound:
@@ -185,6 +194,38 @@ class NodeWebServer:
         if path == "/api/metrics":
             return self.ops.metrics_snapshot()
         raise RouteNotFound(path)
+
+    def handle_traces(self, path: str) -> tuple[str, bytes]:
+        """GET /traces — spans from the live tracer's ring buffer.
+
+        Query params: ``trace_id`` filters to one trace; ``limit`` caps
+        returned spans (newest kept); ``format=jsonl`` streams one span per
+        line (the export format) instead of the grouped-JSON default. With
+        tracing disabled (the no-op default) the answer is well-formed and
+        empty — scraping is always safe."""
+        from urllib.parse import parse_qs, urlsplit
+        from ..observability import get_tracer
+        q = parse_qs(urlsplit(path).query)
+        trace_id = q.get("trace_id", [None])[0]
+        limit_raw = q.get("limit", [None])[0]
+        limit = int(limit_raw) if limit_raw is not None else None
+        fmt = q.get("format", ["json"])[0]
+        tracer = get_tracer()
+        if fmt == "jsonl":
+            ring = getattr(tracer, "ring", None)
+            body = ring.to_jsonl(trace_id=trace_id, limit=limit) if ring \
+                else ""
+            return "application/x-ndjson", body.encode()
+        if trace_id is not None:
+            spans = tracer.trace(trace_id)
+            if limit is not None:
+                spans = spans[-limit:]
+            payload = {"enabled": tracer.enabled, "trace_id": trace_id,
+                       "spans": spans}
+        else:
+            payload = {"enabled": tracer.enabled,
+                       "traces": tracer.traces(limit_spans=limit)}
+        return "application/json", json.dumps(payload, indent=2).encode()
 
     def handle_post(self, path: str, args):
         prefix = "/api/flows/"

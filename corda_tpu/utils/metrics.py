@@ -7,6 +7,8 @@ for export (the JMX analog is `snapshot()` → dict, consumable by any exporter)
 """
 from __future__ import annotations
 
+import bisect
+import math
 import threading
 import time
 from collections import defaultdict
@@ -56,6 +58,76 @@ class Timer:
         return self.total_s / self.count if self.count else 0.0
 
 
+#: Fixed log-scaled bucket upper bounds: quarter-decade steps (×~1.78)
+#: from 1e-6 to 1e7 — one layout covers microsecond latencies AND 32k-item
+#: batch sizes, so every histogram snapshot/exposition has identical shape
+#: and two registries' histograms are directly comparable.
+_HIST_BOUNDS = tuple(10.0 ** (e / 4.0) for e in range(-24, 29))
+
+
+class Histogram:
+    """Fixed-bucket log-scaled histogram with quantile snapshots.
+
+    Quantiles are bucket-resolution estimates (within ×10^0.25 ≈ 1.78 of
+    the true value), clamped to the observed max — the standard
+    fixed-bucket trade: O(1) update, O(buckets) snapshot, no per-sample
+    storage, mergeable across processes by summing counts."""
+
+    BOUNDS = _HIST_BOUNDS
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.BOUNDS) + 1)  # +1 overflow bucket
+        self.count = 0
+        self.total = 0.0
+        self.max_value = 0.0
+
+    def update(self, value: float) -> None:
+        v = float(value)
+        idx = bisect.bisect_left(self.BOUNDS, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self.count += 1
+            self.total += v
+            if v > self.max_value:
+                self.max_value = v
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.update(time.perf_counter() - self._start)
+        return False
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket holding the q-quantile sample (0 when
+        empty), clamped to the observed maximum."""
+        with self._lock:
+            counts = list(self._counts)
+            count = self.count
+            max_v = self.max_value
+        if count == 0:
+            return 0.0
+        target = max(1, math.ceil(q * count))
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= target:
+                if i < len(self.BOUNDS):
+                    return min(self.BOUNDS[i], max_v)
+                return max_v
+        return max_v
+
+    def snapshot_fields(self) -> dict:
+        with self._lock:
+            count, total, max_v = self.count, self.total, self.max_value
+        return {"count": count, "sum": total, "max": max_v,
+                "mean": total / count if count else 0.0,
+                "p50": self.quantile(0.50), "p90": self.quantile(0.90),
+                "p99": self.quantile(0.99)}
+
+
 class Counter:
     """Up/down counter (the in-flight gauge analog)."""
 
@@ -94,6 +166,9 @@ class MetricRegistry:
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
 
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
     def gauge(self, name: str, fn) -> None:
         with self._lock:
             self._metrics[name] = fn
@@ -109,6 +184,8 @@ class MetricRegistry:
                 out[name] = {"count": m.count, "mean_s": m.mean_s(), "max_s": m.max_s}
             elif isinstance(m, Counter):
                 out[name] = {"value": m.value}
+            elif isinstance(m, Histogram):
+                out[name] = m.snapshot_fields()
             else:
                 out[name] = {"value": m()}
         return out

@@ -228,13 +228,20 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             next(self._ids), ltx, self.network_service.my_address))
 
     def verify_signed(self, stx, services,
-                      check_sufficient_signatures: bool = True) -> Future:
+                      check_sufficient_signatures: bool = True,
+                      trace_ctx=None) -> Future:
         """Full SignedTransaction verification with the signature EC math on
         the WORKER's device batcher (SignedTransaction.verify semantics,
         SignedTransaction.kt:174-178, shipped over the VerifierApi seam).
         Coverage (missing-signer) checks are cheap and need the stx, so they
         run node-side before dispatch; resolution happens node-side because
-        it needs the ServiceHub."""
+        it needs the ServiceHub. The worker hop is opaque to tracing — one
+        "verifier.oop_submit" span marks the dispatch in the caller's
+        trace."""
+        from ..observability import get_tracer
+        get_tracer().record("verifier.oop_submit", parent=trace_ctx,
+                            tx_id=stx.id.bytes.hex()[:16],
+                            n_sigs=len(stx.sigs))
         if check_sufficient_signatures:
             missing = stx.get_missing_signatures()
             if missing:

@@ -15,9 +15,11 @@ seam (NodeConfiguration.kt:91-94) is `make_verifier_service`.
 """
 from __future__ import annotations
 
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from ..core.crypto.signatures import SignatureException
+from ..observability import get_tracer
 from ..utils.metrics import MetricRegistry
 from .batcher import SignatureBatcher
 
@@ -30,24 +32,36 @@ class TransactionVerifierService:
     metrics: MetricRegistry
     _pool: ThreadPoolExecutor
 
-    def verify(self, ltx) -> Future:
-        return self._submit_instrumented(ltx.verify)
+    #: capability flag callers probe before passing trace_ctx — a custom
+    #: service with the pre-observability signature keeps working
+    supports_trace_ctx = True
+
+    def verify(self, ltx, trace_ctx=None) -> Future:
+        return self._submit_instrumented(ltx.verify, trace_ctx=trace_ctx)
 
     def verify_signed(self, stx, services,
-                      check_sufficient_signatures: bool = True) -> Future:
+                      check_sufficient_signatures: bool = True,
+                      trace_ctx=None) -> Future:
         """Async full verify of a SignedTransaction on the service's pool —
         the future every backend offers the SMM's Verify suspension point
         (flows park on it instead of blocking the node thread). Subclasses
         accelerate it (Tpu: device-batched signatures; OutOfProcess: worker
         fan-out); this base version runs `stx.verify` host-side."""
-        return self._submit_instrumented(lambda: stx.verify(
-            services, check_sufficient_signatures=check_sufficient_signatures))
+        return self._submit_instrumented(
+            lambda: stx.verify(
+                services,
+                check_sufficient_signatures=check_sufficient_signatures),
+            trace_ctx=trace_ctx)
 
-    def _submit_instrumented(self, work_fn) -> Future:
+    def _submit_instrumented(self, work_fn, trace_ctx=None) -> Future:
         self.metrics.counter("Verification.InFlight").inc()
+        hist = self.metrics.histogram("tx_verify_seconds")
+        tracer = get_tracer()
 
         def work():
-            with self.metrics.timer("Verification.Duration"):
+            t0 = time.perf_counter()
+            with self.metrics.timer("Verification.Duration"), \
+                    tracer.span("verifier.run", parent=trace_ctx):
                 try:
                     result = work_fn()
                     self.metrics.meter("Verification.Success").mark()
@@ -57,6 +71,7 @@ class TransactionVerifierService:
                     raise
                 finally:
                     self.metrics.counter("Verification.InFlight").dec()
+                    hist.update(time.perf_counter() - t0)
 
         return self._pool.submit(work)
 
@@ -100,28 +115,45 @@ class TpuTransactionVerifierService(TransactionVerifierService):
 
     # -- full TPU path (verify(ltx) is inherited) ----------------------------
     def verify_signed(self, stx, services,
-                      check_sufficient_signatures: bool = True) -> Future:
+                      check_sufficient_signatures: bool = True,
+                      trace_ctx=None) -> Future:
         """Async full verify of a SignedTransaction; the per-signature EC math
-        rides the shared device batcher (cross-transaction batching)."""
+        rides the shared device batcher (cross-transaction batching). With
+        tracing enabled the whole pipeline — submit, batch flush, device
+        dispatch, resolve — lands in one trace rooted here (or in the
+        caller's, when ``trace_ctx`` carries the flow's context)."""
+        tracer = get_tracer()
+        root = tracer.span("tx.verify", parent=trace_ctx,
+                           tx_id=stx.id.bytes.hex()[:16],
+                           n_sigs=len(stx.sigs))
+        ctx = root.context()
+        tracer.record("verifier.submit", parent=ctx, n_sigs=len(stx.sigs))
         sig_futures = list(zip(stx.sigs, self.batcher.submit_many(
-            [(sig.by, sig.bytes, stx.id.bytes) for sig in stx.sigs])))
+            [(sig.by, sig.bytes, stx.id.bytes) for sig in stx.sigs],
+            ctx=ctx)))
 
         def work():
-            for sig, fut in sig_futures:
-                if not fut.result():
-                    raise SignatureException(
-                        f"Signature by {sig.by.to_string_short()} did "
-                        f"not verify on transaction {stx.id.prefix_chars()}")
-            if check_sufficient_signatures:
-                missing = stx.get_missing_signatures()
-                if missing:
-                    from ..core.transactions.signed import (
-                        SignaturesMissingException)
-                    raise SignaturesMissingException(
-                        missing, [k.to_string_short() for k in missing], stx.id)
-            stx.to_ledger_transaction(services).verify()
+            try:
+                for sig, fut in sig_futures:
+                    if not fut.result():
+                        raise SignatureException(
+                            f"Signature by {sig.by.to_string_short()} did "
+                            f"not verify on transaction "
+                            f"{stx.id.prefix_chars()}")
+                if check_sufficient_signatures:
+                    missing = stx.get_missing_signatures()
+                    if missing:
+                        from ..core.transactions.signed import (
+                            SignaturesMissingException)
+                        raise SignaturesMissingException(
+                            missing, [k.to_string_short() for k in missing],
+                            stx.id)
+                with tracer.span("verifier.resolve", parent=ctx):
+                    stx.to_ledger_transaction(services).verify()
+            finally:
+                root.finish()
 
-        return self._submit_instrumented(work)
+        return self._submit_instrumented(work, trace_ctx=ctx)
 
     def shutdown(self) -> None:
         super().shutdown()

@@ -1,0 +1,156 @@
+"""Hostile/malformed wire inputs: duplicate schema field names, evolved
+defaults that must freeze, and adversarial partial-Merkle trees (deep
+chains, junk nodes) that must mark only themselves False in a batch."""
+import dataclasses
+import hashlib
+from types import SimpleNamespace
+
+import msgpack
+import pytest
+
+from corda_tpu.core.crypto.merkle import _IncludedLeaf, _Leaf, _Node
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.core.serialization import SerializationError, codec
+from corda_tpu.core.transactions.batch_merkle import (MAX_PROOF_DEPTH,
+                                                      verify_filtered_batch)
+
+NAME = "hostile.DemoState"
+
+
+def _schema_blob(name, field_names, fields):
+    """Hand-forge a schema'd-object wire message (what a hostile peer can
+    put on the wire directly — the codec itself never emits duplicates)."""
+    return codec._MAGIC + codec._packb(msgpack.ExtType(
+        codec._EXT_OBJ_SCHEMA, codec._packb([name, list(field_names),
+                                             list(fields)])))
+
+
+def _unregister(cls):
+    codec._REGISTRY.pop(NAME, None)
+    codec._BY_CLASS.pop(cls, None)
+    codec._SCHEMA_NAMES.pop(NAME, None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    yield
+    for cls in (DemoV1, DemoV2):
+        _unregister(cls)
+    entry = codec._CARPENTED.pop(NAME, None)
+    if entry is not None:
+        for cls, cname in list(codec._CARPENTED_BY_CLASS.items()):
+            if cname == NAME:
+                del codec._CARPENTED_BY_CLASS[cls]
+
+
+@dataclasses.dataclass(frozen=True)
+class DemoV1:
+    amount: int
+
+
+@dataclasses.dataclass(frozen=True)
+class DemoV2:
+    """v2 adds a collection field with a list-producing default_factory."""
+
+    amount: int
+    tags: tuple = dataclasses.field(default_factory=lambda: [1, 2])
+
+
+# ---------------------------------------------------------------------------
+# codec: duplicate field names
+# ---------------------------------------------------------------------------
+
+def test_duplicate_field_names_rejected_for_carpented_type():
+    blob = _schema_blob(NAME, ["amount", "amount"], [1, 2])
+    with pytest.raises(SerializationError, match="duplicate field names"):
+        codec.deserialize(blob)
+    # the hostile name must NOT have been carpented as a side effect
+    assert NAME not in codec._CARPENTED
+
+
+def test_duplicate_field_names_rejected_for_registered_type():
+    codec.register_type(NAME, DemoV1, carry_schema=True)
+    blob = _schema_blob(NAME, ["amount", "amount"], [1, 2])
+    with pytest.raises(SerializationError, match="duplicate field names"):
+        codec.deserialize(blob)
+
+
+def test_unique_field_names_still_roundtrip():
+    codec.register_type(NAME, DemoV1, carry_schema=True)
+    assert codec.deserialize(codec.serialize(DemoV1(5))) == DemoV1(5)
+
+
+# ---------------------------------------------------------------------------
+# codec: evolved defaults freeze like carried values
+# ---------------------------------------------------------------------------
+
+def test_evolved_default_factory_value_is_frozen():
+    codec.register_type(NAME, DemoV1, carry_schema=True)
+    blob = codec.serialize(DemoV1(7))
+    _unregister(DemoV1)
+    codec.register_type(NAME, DemoV2, carry_schema=True)
+    got = codec.deserialize(blob)
+    # the factory returns a LIST; the evolved instance must carry the
+    # frozen (tuple) form so it hashes/compares like a native decode
+    assert got.tags == (1, 2)
+    assert isinstance(got.tags, tuple)
+    hash(got)   # frozen dataclass with tuple fields is hashable
+
+
+# ---------------------------------------------------------------------------
+# batch_merkle: hostile trees mark only themselves False
+# ---------------------------------------------------------------------------
+
+def _good_ftx():
+    la, lb = SecureHash.sha256(b"a"), SecureHash.sha256(b"b")
+    root = _Node(_IncludedLeaf(la), _IncludedLeaf(lb))
+    root_hash = SecureHash(hashlib.sha256(la.bytes + lb.bytes).digest())
+    return SimpleNamespace(
+        partial_merkle_tree=SimpleNamespace(root=root),
+        filtered_leaves=SimpleNamespace(
+            available_component_hashes=[la, lb]),
+        root_hash=root_hash)
+
+
+def _ftx_with_root(root):
+    h = SecureHash.sha256(b"x")
+    return SimpleNamespace(
+        partial_merkle_tree=SimpleNamespace(root=root),
+        filtered_leaves=SimpleNamespace(available_component_hashes=[h]),
+        root_hash=h)
+
+
+def test_deep_chain_marks_only_itself_false():
+    chain = _IncludedLeaf(SecureHash.sha256(b"x"))
+    filler = _Leaf(SecureHash.sha256(b"pad"))
+    for _ in range(MAX_PROOF_DEPTH + 200):
+        chain = _Node(chain, filler)
+    # iterative walk: no RecursionError, and only the hostile member fails
+    got = verify_filtered_batch(
+        [_good_ftx(), _ftx_with_root(chain), _good_ftx()])
+    assert got == [True, False, True]
+
+
+def test_junk_node_and_broken_ftx_mark_only_themselves_false():
+    got = verify_filtered_batch([
+        _good_ftx(),
+        _ftx_with_root("not a tree node"),
+        SimpleNamespace(),              # no partial_merkle_tree at all
+        _good_ftx()])
+    assert got == [True, False, False, True]
+
+
+def test_depth_within_cap_still_verifies():
+    # a legitimate (small) unbalanced shape well inside the cap
+    la, lb = SecureHash.sha256(b"a"), SecureHash.sha256(b"b")
+    inner = _Node(_IncludedLeaf(la), _IncludedLeaf(lb))
+    inner_h = hashlib.sha256(la.bytes + lb.bytes).digest()
+    lc = SecureHash.sha256(b"c")
+    root = _Node(inner, _Leaf(lc))
+    root_hash = SecureHash(hashlib.sha256(inner_h + lc.bytes).digest())
+    ftx = SimpleNamespace(
+        partial_merkle_tree=SimpleNamespace(root=root),
+        filtered_leaves=SimpleNamespace(
+            available_component_hashes=[la, lb]),
+        root_hash=root_hash)
+    assert verify_filtered_batch([ftx]) == [True]

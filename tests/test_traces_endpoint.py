@@ -1,0 +1,131 @@
+"""/traces endpoint + the end-to-end acceptance trace: one transaction
+verified through TransactionVerifierService produces ONE trace whose spans
+cover submit → batch flush → dispatch → resolve, retrievable over HTTP."""
+import json
+import urllib.request
+
+import pytest
+
+import corda_tpu.finance  # noqa: F401
+from corda_tpu.core.contracts import Command, TransactionState
+from corda_tpu.core.crypto import generate_keypair
+from corda_tpu.core.identity import Party
+from corda_tpu.core.transactions import WireTransaction
+from corda_tpu.node.rpc import CordaRPCOps
+from corda_tpu.observability import disable_tracing, enable_tracing
+from corda_tpu.testing import (DUMMY_NOTARY_NAME, DummyContract, DummyState,
+                               MockNetwork, MockServices)
+from corda_tpu.verifier import TpuTransactionVerifierService
+
+NOTARY_KP = generate_keypair(entropy=b"\x20" * 32)
+NOTARY = Party(DUMMY_NOTARY_NAME, NOTARY_KP.public)
+ALICE_KP = generate_keypair(entropy=b"\x21" * 32)
+
+
+@pytest.fixture(autouse=True)
+def _noop_after():
+    yield
+    disable_tracing()
+
+
+def _make_stx(services):
+    wtx = WireTransaction(
+        outputs=(TransactionState(DummyState(7, (ALICE_KP.public,)), NOTARY),),
+        commands=(Command(DummyContract.Create(), (ALICE_KP.public,)),),
+        notary=NOTARY, must_sign=(ALICE_KP.public,))
+    return services.sign_transaction(wtx, ALICE_KP.public)
+
+
+def _verify_one_stx():
+    services = MockServices(key_pairs=[NOTARY_KP, ALICE_KP], parties=[NOTARY])
+    svc = TpuTransactionVerifierService()
+    try:
+        assert svc.verify_signed(_make_stx(services),
+                                 services).result(timeout=120) is None
+    finally:
+        svc.shutdown()
+
+
+def test_single_tx_verify_produces_one_end_to_end_trace():
+    tracer = enable_tracing()
+    _verify_one_stx()
+    traces = tracer.traces()
+    # ONE trace: every span of the pipeline shares the root's trace id
+    assert len(traces) == 1
+    (spans,) = traces.values()
+    names = {s["name"] for s in spans}
+    assert {"tx.verify", "verifier.submit", "batcher.enqueue_wait",
+            "batcher.flush", "batcher.dispatch", "batcher.resolve",
+            "verifier.resolve", "verifier.run"} <= names
+    roots = [s for s in spans if s["name"] == "tx.verify"]
+    assert len(roots) == 1 and roots[0]["parent_id"] is None
+    assert roots[0]["tags"]["n_sigs"] == 1
+    dispatch = next(s for s in spans if s["name"] == "batcher.dispatch")
+    assert dispatch["tags"]["route"] in ("host", "device")
+    # parent links all resolve within the same trace
+    ids = {s["span_id"] for s in spans}
+    for s in spans:
+        assert s["parent_id"] is None or s["parent_id"] in ids
+
+
+@pytest.fixture
+def web():
+    network = MockNetwork()
+    network.create_notary_node()
+    alice = network.create_node("O=Alice, L=Madrid, C=ES")
+    network.start_nodes()
+    from corda_tpu.tools.webserver import NodeWebServer
+    ops = CordaRPCOps(alice.services, alice.smm)
+    server = NodeWebServer(ops, pump=network.run_network).start()
+    yield server
+    server.stop()
+
+
+def _get_json(server, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_traces_endpoint_disabled_then_live(web):
+    server = web
+    # no-op tracer: well-formed empty answer, never an error
+    out = _get_json(server, "/traces")
+    assert out == {"enabled": False, "traces": {}}
+    tracer = enable_tracing()
+    _verify_one_stx()
+    out = _get_json(server, "/traces")
+    assert out["enabled"] is True and len(out["traces"]) == 1
+    (trace_id,) = out["traces"]
+    names = {s["name"] for s in out["traces"][trace_id]}
+    assert {"tx.verify", "batcher.flush", "batcher.dispatch",
+            "batcher.resolve"} <= names
+    # filtered + limited view
+    one = _get_json(server, f"/traces?trace_id={trace_id}&limit=2")
+    assert one["trace_id"] == trace_id and len(one["spans"]) == 2
+    assert _get_json(server, "/traces?trace_id=feedfacedeadbeef")["spans"] == []
+    # JSONL export view: one JSON object per line, same span set
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/traces?format=jsonl",
+            timeout=10) as r:
+        assert r.headers["Content-Type"].startswith("application/x-ndjson")
+        lines = [json.loads(l) for l in r.read().decode().splitlines()]
+    assert {s["name"] for s in lines} == {s["name"] for s in tracer.spans()}
+
+
+def test_metrics_endpoint_exposes_verifier_histograms():
+    from corda_tpu.tools.webserver import prometheus_text
+    from corda_tpu.utils.metrics import MetricRegistry
+    reg = MetricRegistry()
+    services = MockServices(key_pairs=[NOTARY_KP, ALICE_KP], parties=[NOTARY])
+    svc = TpuTransactionVerifierService(metrics=reg)
+    try:
+        assert svc.verify_signed(_make_stx(services),
+                                 services).result(timeout=120) is None
+    finally:
+        svc.shutdown()
+    text = prometheus_text(reg.snapshot())
+    for metric in ("verifier_batch_size", "verifier_dispatch_seconds",
+                   "tx_verify_seconds"):
+        for q in ("p50", "p90", "p99"):
+            assert f"corda_tpu_{metric}_{q}" in text, (metric, q)
