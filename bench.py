@@ -19,6 +19,15 @@ Env knobs:
   CORDA_TPU_BENCH_UNIQUE  1 → sign a fully-unique batch (no tiling) for the
                           gather-locality A/B (VERDICT r4 weak #6); slow
                           (pure-Python signing), meant for one-off runs
+
+Flags:
+  --smoke    tiny-batch wiring check: exercises the FULL service path
+             (SignatureBatcher drain → per-scheme prep pool → resolve)
+             on the host-crossover route only — every batch stays under
+             ``host_crossover`` so no device kernel compiles, making it
+             fast enough for a tier-1 CPU test (tests/test_bench_smoke.py).
+             Kernel-rate fields are emitted as 0.0 and "smoke": true is
+             added; every other JSON field keeps its shape.
 """
 from __future__ import annotations
 
@@ -26,6 +35,7 @@ import json
 import os
 import pathlib
 import statistics
+import sys
 import time
 
 import numpy as np
@@ -41,10 +51,15 @@ from corda_tpu.core.crypto import ecmath
 from corda_tpu.ops import ed25519 as ed_ops
 from corda_tpu.ops import weierstrass as wc_ops
 
-BATCH = int(os.environ.get("CORDA_TPU_BENCH_N", 32768))
-UNIQUE = BATCH if os.environ.get("CORDA_TPU_BENCH_UNIQUE") else 512
-REPS = 3
-SERVICE_RUNS = 3   # service numbers are medians of this many runs
+SMOKE = "--smoke" in sys.argv
+# smoke: small enough that every per-scheme drain stays below the batcher's
+# host_crossover (192) even when REPS groups coalesce into one flush
+BATCH = int(os.environ.get("CORDA_TPU_BENCH_N", 48 if SMOKE else 32768))
+UNIQUE = (BATCH if os.environ.get("CORDA_TPU_BENCH_UNIQUE")
+          else (16 if SMOKE else 512))
+REPS = 1 if SMOKE else 3
+SERVICE_RUNS = 1 if SMOKE else 3
+                   # service numbers are medians of SERVICE_RUNS runs
                    # (tunnel variance is ±20%; BASELINE.md methodology note)
 
 
@@ -182,7 +197,9 @@ def _service_rate_for(batcher, triples) -> float:
 def service_metrics(k1_items, ed_items, r1_items):
     """Service-path numbers through the SignatureBatcher seam (host prep +
     device kernel + future resolution — what a node actually gets): k1,
-    ed25519, and a mixed-scheme stream; p50 @ batch=1 and @ batch=1k."""
+    ed25519, r1, and a mixed-scheme stream; p50 @ batch=1 and @ batch=1k;
+    the prep-overlap high-water mark (how many scheme preps actually ran
+    concurrently on the prep pool)."""
     from corda_tpu.core.crypto.schemes import ECDSA_SECP256R1_SHA256
     from corda_tpu.observability import stage_percentiles
     from corda_tpu.utils.metrics import MetricRegistry
@@ -190,23 +207,23 @@ def service_metrics(k1_items, ed_items, r1_items):
 
     k1_triples = _k1_triples(k1_items)
     ed_triples = _ed_triples(ed_items)
+    r1_full = _ecdsa_triples(r1_items, ecmath.SECP256R1,
+                             ECDSA_SECP256R1_SHA256)
     n = len(k1_triples)
     # GeneratedLedger-style mix (BASELINE config 2 direction): the default
     # scheme dominates, k1 heavy, r1 present (VerifierTests.kt:37-100 uses
     # mixed generated ledgers as the verification corpus)
-    r1_triples = _ecdsa_triples(
-        r1_items[: max(1, n - 2 * int(0.45 * n))],
-        ecmath.SECP256R1, ECDSA_SECP256R1_SHA256)
     mixed = (ed_triples[: int(0.45 * n)] + k1_triples[: int(0.45 * n)]
-             + r1_triples)
+             + r1_full[: max(1, n - 2 * int(0.45 * n))])
     registry = MetricRegistry()
     batcher = SignatureBatcher(metrics=registry)
     try:
         k1_rate = _service_rate_for(batcher, k1_triples)
         ed_rate = _service_rate_for(batcher, ed_triples)
+        r1_rate = _service_rate_for(batcher, r1_full)
         mixed_rate = _service_rate_for(batcher, mixed)
         latencies = []
-        for i in range(41):
+        for i in range(5 if SMOKE else 41):
             key, der, msg = k1_triples[i % len(k1_triples)]
             t0 = time.perf_counter()
             assert batcher.submit(key, der, msg).result(timeout=60)
@@ -216,10 +233,12 @@ def service_metrics(k1_items, ed_items, r1_items):
         # between the host crossover (192) and dispatch-floor amortization
         # (~8k) pays the linger window plus the fixed device dispatch.
         # Warm the 1k bucket first so its compile doesn't pollute samples.
+        # (--smoke holds BATCH below the crossover, so `sub` stays on the
+        # host route there — same submit shape, no kernel compile.)
         sub = k1_triples[:1024]
         assert all(batcher.submit_group(sub).result(timeout=900))
         mid = []
-        for _ in range(9):
+        for _ in range(3 if SMOKE else 9):
             t0 = time.perf_counter()
             assert all(batcher.submit_group(sub).result(timeout=120))
             mid.append(time.perf_counter() - t0)
@@ -228,21 +247,30 @@ def service_metrics(k1_items, ed_items, r1_items):
         batcher.close()
     # per-stage latency breakdown (prep / dispatch / finish percentiles)
     # from the batcher's histograms — where a verify's time actually went
-    stages = stage_percentiles(registry.snapshot())
-    return k1_rate, ed_rate, mixed_rate, p50_ms, p50_1k_ms, stages
+    snap = registry.snapshot()
+    stages = stage_percentiles(snap)
+    overlap = snap.get("SigBatcher.PrepActive", {}).get("max", 0)
+    return (k1_rate, ed_rate, r1_rate, mixed_rate, p50_ms, p50_1k_ms,
+            stages, overlap)
 
 
 def main() -> None:
+    from corda_tpu.verifier.batcher import SignatureBatcher
     items = make_items(BATCH)
     ed_items = make_ed_items(BATCH)
     r1_items = make_items(BATCH, ecmath.SECP256R1)
-    dev = device_rate(items)
-    ed_dev = ed_device_rate(ed_items)
-    r1_dev = r1_device_rate(r1_items)
-    k1_rate, ed_rate, mixed_rate, p50_ms, p50_1k_ms, stages = service_metrics(
-        items, ed_items, r1_items)
+    if SMOKE:
+        # host-crossover route only: no device kernel compiles on the
+        # wiring check; kernel-rate fields keep their slots at 0.0
+        dev = ed_dev = r1_dev = 0.0
+    else:
+        dev = device_rate(items)
+        ed_dev = ed_device_rate(ed_items)
+        r1_dev = r1_device_rate(r1_items)
+    (k1_rate, ed_rate, r1_rate, mixed_rate, p50_ms, p50_1k_ms, stages,
+     overlap) = service_metrics(items, ed_items, r1_items)
     host = host_baseline_rate(items[: min(128, BATCH)])
-    print(json.dumps({
+    out = {
         "metric": "ecdsa_secp256k1_verifies_per_sec_per_chip",
         "value": round(dev, 1),
         "unit": "verifies/s",
@@ -251,13 +279,20 @@ def main() -> None:
         "secp256r1_verifies_per_sec_per_chip": round(r1_dev, 1),
         "service_path_verifies_per_sec": round(k1_rate, 1),
         "ed25519_service_path_verifies_per_sec": round(ed_rate, 1),
+        "secp256r1_service_path_verifies_per_sec": round(r1_rate, 1),
         "mixed_service_path_verifies_per_sec": round(mixed_rate, 1),
         "tx_verify_p50_ms_batch1": round(p50_ms, 3),
         "tx_verify_p50_ms_batch1k": round(p50_1k_ms, 3),
         "host_baseline_verifies_per_sec": round(host, 1),
         "unique_signatures": UNIQUE,
+        "prep_workers": SignatureBatcher.PREP_WORKERS,
+        "prep_inflight_depth": SignatureBatcher.MAX_IN_FLIGHT,
+        "prep_overlap_max": overlap,
         **stages,
-    }))
+    }
+    if SMOKE:
+        out["smoke"] = True
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

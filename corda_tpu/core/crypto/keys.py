@@ -113,6 +113,27 @@ def _decompress_lru(curve_name: str, data: bytes):
     return sec1_decompress(curve, data)
 
 
+def sec1_pub_row_cached(curve: ecmath.WeierstrassCurve, data: bytes):
+    """``sec1_decompress_cached`` in the native preps' wire format: the (8,)
+    little-endian u64 row (x ‖ y, 32 LE bytes each) that sm_k1_prep /
+    sm_r1_prep consume. Memoized per (curve, encoding) — the batcher's ECDSA
+    prep copies one cached row per item instead of paying decompress plus
+    two ``to_bytes`` round trips (the Weierstrass analog of the Ed25519
+    kernel's per-signer A′ row cache). Returns None for invalid encodings."""
+    return _pub_row_lru(curve.name, bytes(data))
+
+
+@functools.lru_cache(maxsize=65536)
+def _pub_row_lru(curve_name: str, data: bytes):
+    import numpy as np
+    pt = _decompress_lru(curve_name, data)
+    if pt is None:
+        return None
+    # frombuffer over bytes is read-only — safe to share across batches
+    return np.frombuffer(pt[0].to_bytes(32, "little")
+                         + pt[1].to_bytes(32, "little"), dtype="<u8")
+
+
 def sec1_decompress(curve: ecmath.WeierstrassCurve, data: bytes):
     if len(data) == 65 and data[0] == 4:
         x = int.from_bytes(data[1:33], "big")

@@ -574,18 +574,30 @@ def prepare_batch_split(items: list[tuple[bytes, bytes, bytes]],
     n = len(items)
     rows = np.empty((n, 6, F.NLIMB), dtype=np.uint16)
     precheck = np.ones(n, dtype=bool)
-    sig_mat = np.zeros((n, 64), dtype=np.uint8)
     digests: list[bytes] = []
     sub = _substitute_row()
+    # signature bytes land in ONE joined frombuffer when every sig is the
+    # wire-format 64 bytes (the overwhelmingly common case) — n per-row
+    # frombuffer copies otherwise. Items whose KEY fails decompression keep
+    # their sig bytes here; their verdict is masked by precheck anyway.
+    sig_ok = np.fromiter((len(sig) == 64 for _, sig, _ in items),
+                         dtype=bool, count=n)
+    if sig_ok.all():
+        sig_mat = np.frombuffer(b"".join(sig for _, sig, _ in items),
+                                dtype=np.uint8).reshape(n, 64)
+    else:
+        sig_mat = np.zeros((n, 64), dtype=np.uint8)
+        for i, (_, sig, _) in enumerate(items):
+            if sig_ok[i]:
+                sig_mat[i] = np.frombuffer(sig, dtype=np.uint8)
     for i, (pub, sig, msg) in enumerate(items):
-        row = _signer_row(bytes(pub)) if len(sig) == 64 else None
+        row = _signer_row(bytes(pub)) if sig_ok[i] else None
         if row is None:
             precheck[i] = False
             rows[i] = sub
             digests.append(bytes(64))   # k := 0 (verdict is masked anyway)
         else:
             rows[i] = row
-            sig_mat[i] = np.frombuffer(sig, dtype=np.uint8)
             digests.append(hashlib.sha512(sig[:32] + pub + msg).digest())
     r_packed = sig_mat[:, :32].copy().view("<u2")       # (n, 16) wire y
     # the wire sign bit stays IN limb 15 bit 15 (the kernel unpacks it);

@@ -29,6 +29,8 @@ Design (SURVEY.md §7 phase 1 "limb-decomposed lanes"):
 """
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -711,6 +713,7 @@ def inv25519(a):
 # ---------------------------------------------------------------------------
 
 _DEVICE_TABLE_CACHE: dict = {}
+_DEVICE_TABLE_LOCK = threading.Lock()
 
 
 def device_table_cache(key, build):
@@ -718,10 +721,19 @@ def device_table_cache(key, build):
     constant-G / Niels tables): ``build()`` runs once per key, its arrays
     are device_put once per process, and repeat calls hand back the same
     committed buffers (zero per-call transfer). Tables are ARGUMENTS to
-    kernels, never HLO constants — multi-MB literals explode compile time."""
-    if key not in _DEVICE_TABLE_CACHE:
-        _DEVICE_TABLE_CACHE[key] = tuple(jax.device_put(t) for t in build())
-    return _DEVICE_TABLE_CACHE[key]
+    kernels, never HLO constants — multi-MB literals explode compile time.
+
+    Builds are serialized under a lock: the batcher's per-scheme prep pool
+    can race two first-use preps of the same scheme, and the multi-MB
+    table builds are exactly the work worth doing once."""
+    tabs = _DEVICE_TABLE_CACHE.get(key)
+    if tabs is None:
+        with _DEVICE_TABLE_LOCK:
+            tabs = _DEVICE_TABLE_CACHE.get(key)
+            if tabs is None:
+                tabs = _DEVICE_TABLE_CACHE[key] = tuple(
+                    jax.device_put(t) for t in build())
+    return tabs
 
 
 def bucket_size(n: int, floor: int = 8) -> int:

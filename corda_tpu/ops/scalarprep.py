@@ -105,6 +105,51 @@ def le_digests_to_words(digests: list[bytes], nwords: int) -> np.ndarray:
         len(digests), nwords).copy()
 
 
+def ecdsa_sigs_to_words(sigs) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Strict-DER ECDSA signatures → (r_words (B,4), s_words (B,4),
+    ok (B,) bool), the preps' LE u64 wire format — a batched
+    ``ecmath.ecdsa_sig_from_der`` that skips the Python-bigint round trip
+    (parse to int, then ints_to_words immediately re-serializes; at 32k
+    items that double conversion was a measurable slice of ECDSA prep).
+
+    Acceptance set is exactly ecdsa_sig_from_der's (tag/length/minimality/
+    sign/trailing checks) plus the >= 2^256 clamp of the item-loop prep.
+    Rejected encodings get ok=False and an all-zero row — r = 0 fails the
+    preps' range precheck, so the member's verdict is False either way
+    (locked by the test_scalarprep differential)."""
+    n = len(sigs)
+    r_rows = np.zeros((n, 32), dtype=np.uint8)
+    s_rows = np.zeros((n, 32), dtype=np.uint8)
+    ok = np.ones(n, dtype=bool)
+    for i, der in enumerate(sigs):
+        if len(der) < 8 or der[0] != 0x30 or der[1] != len(der) - 2:
+            ok[i] = False
+            continue
+        idx, bad = 2, False
+        for rows in (r_rows, s_rows):
+            if idx + 2 > len(der) or der[idx] != 0x02:
+                bad = True
+                break
+            ln = der[idx + 1]
+            body = der[idx + 2:idx + 2 + ln]
+            if (ln == 0 or len(body) != ln or body[0] & 0x80
+                    or (ln > 1 and body[0] == 0 and not (body[1] & 0x80))):
+                bad = True
+                break
+            if body[0] == 0:
+                body = body[1:]     # minimal leading zero (sign byte)
+            if len(body) > 32:      # >= 2^256: clamp-to-reject
+                bad = True
+                break
+            rows[i, :len(body)] = np.frombuffer(body, dtype=np.uint8)[::-1]
+            idx += 2 + ln
+        if bad or idx != len(der):
+            ok[i] = False
+            r_rows[i] = 0
+            s_rows[i] = 0
+    return r_rows.view("<u8"), s_rows.view("<u8"), ok
+
+
 # ---------------------------------------------------------------------------
 # Test seams
 # ---------------------------------------------------------------------------

@@ -907,15 +907,24 @@ def prepare_batch_windowed_single(curve: WeierstrassCurve, items,
     before precheck so ``*args, precheck`` callers pass through)."""
     from . import scalarprep as sp
     if w == 16 and curve.name == "secp256r1" and sp.available():
-        e_words, r_words, s_words, pub_words = _items_to_words(items)
-        (g_idx, q_digits, q_x, q_y, r_limbs, rn_ok,
-         precheck) = sp.r1_prep(e_words, r_words, s_words, pub_words)
-        return (jnp.asarray(g_idx),
-                jnp.asarray(q_digits.reshape(256 // w, w // 4, len(items))),
-                (jnp.asarray(q_x), jnp.asarray(q_y)),
-                jnp.asarray(r_limbs), jnp.asarray(rn_ok),
-                *g_window_table_single_device(curve, w), precheck)
+        return _prepare_windowed_single_native_words(
+            *_items_to_words(items), w)
     return _prepare_windowed_single_python(curve, items, w)
+
+
+def _prepare_windowed_single_native_words(e_words, r_words, s_words,
+                                          pub_words, w: int):
+    """Word-form core of the native r1 prep (see
+    _prepare_hybrid_native_words)."""
+    from . import scalarprep as sp
+    curve = CURVES["secp256r1"]
+    (g_idx, q_digits, q_x, q_y, r_limbs, rn_ok,
+     precheck) = sp.r1_prep(e_words, r_words, s_words, pub_words)
+    return (jnp.asarray(g_idx),
+            jnp.asarray(q_digits.reshape(256 // w, w // 4, len(e_words))),
+            (jnp.asarray(q_x), jnp.asarray(q_y)),
+            jnp.asarray(r_limbs), jnp.asarray(rn_ok),
+            *g_window_table_single_device(curve, w), precheck)
 
 
 def _prepare_windowed_single_python(curve: WeierstrassCurve, items,
@@ -1079,13 +1088,21 @@ def _prepare_hybrid_native(items, g_w: int):
     whole scalar layer (precheck, batch s-inversion, GLV split, window
     extraction, limb packing) runs in native/scalarmath.cpp — bit-identical
     outputs to the Python path (tests/test_scalarprep.py)."""
+    return _prepare_hybrid_native_words(*_items_to_words(items), g_w)
+
+
+def _prepare_hybrid_native_words(e_words, r_words, s_words, pub_words,
+                                 g_w: int):
+    """Word-form core of the native hybrid prep: callers that already hold
+    the (B, ·) LE u64 rows (the batcher's cached ECDSA prep, the sharded
+    mesh entry) feed them straight to sm_k1_prep with no item tuples."""
     from . import scalarprep as sp
     curve = CURVES["secp256k1"]
-    e_words, r_words, s_words, pub_words = _items_to_words(items)
+    n = len(e_words)
     (g_idx, q_packed, qc_x, qc_y, qd_x, qd_y, r_limbs,
      rn_ok, precheck) = sp.k1_prep(e_words, r_words, s_words, pub_words)
     n_g = 128 // g_w
-    q_bits = q_packed.reshape(n_g, g_w // 2, len(items))
+    q_bits = q_packed.reshape(n_g, g_w // 2, n)
     g_idx[0] |= rn_ok.astype(np.int32) << 18      # consolidated wire form
     pts = np.stack([qc_x, qc_y, qd_x, qd_y], axis=1)     # (B, 4, 16)
     return (jnp.asarray(g_idx), jnp.asarray(q_bits), jnp.asarray(pts),
@@ -1257,6 +1274,57 @@ def verify_batch_async(curve: WeierstrassCurve,
                 precheck, n)
     *args, precheck = prepare_batch_windowed_single(curve, padded,
                                                     R1_G_WINDOW)
+    return (_verify_kernel_windowed_single(*args, curve_name=curve.name,
+                                           w=R1_G_WINDOW), precheck, n)
+
+
+def words_prep_available(curve: WeierstrassCurve) -> bool:
+    """True when the word-form fast path (:func:`verify_batch_async_words`)
+    covers ``curve``: native scalar prep present AND the production window
+    configs match the native kernels' fixed widths (k1 g_w = 8, r1 w = 16
+    — the only widths scalarmath.cpp implements)."""
+    from . import scalarprep as sp
+    if not sp.available():
+        return False
+    if curve.name == "secp256k1":
+        return HYBRID_G_WINDOW == 8
+    if curve.name == "secp256r1":
+        return R1_G_WINDOW == 16
+    return False
+
+
+def pad_word_rows(arrays, m: int):
+    """Pad each (B, ·) word-row array to m rows by replicating the last row
+    (the word-form analog of verify_batch_async's last-item padding — a
+    repeated valid row verifies identically and is sliced off by
+    finish_batch)."""
+    n = len(arrays[0])
+    if m <= n:
+        return arrays
+    return tuple(np.concatenate([a, np.repeat(a[-1:], m - n, axis=0)])
+                 for a in arrays)
+
+
+def verify_batch_async_words(curve: WeierstrassCurve, e_words, r_words,
+                             s_words, pub_words):
+    """Word-form async dispatch — the batcher's cached/vectorized ECDSA
+    prep path: items arrive as the native preps' LE u64 rows (per-signer
+    pub rows from keys.sec1_pub_row_cached, r/s from the batched DER
+    parse, e from digests_to_words), skipping the per-item decompress +
+    DER + to_bytes loop entirely. Same pending/finish contract as
+    :func:`verify_batch_async`; callers gate on words_prep_available."""
+    n = len(e_words)
+    if n == 0:
+        return (None, np.zeros(0, dtype=bool), 0)
+    e_words, r_words, s_words, pub_words = pad_word_rows(
+        (e_words, r_words, s_words, pub_words), F.bucket_size(n))
+    if curve.name == "secp256k1":
+        *args, precheck = _prepare_hybrid_native_words(
+            e_words, r_words, s_words, pub_words, HYBRID_G_WINDOW)
+        return (_verify_kernel_hybrid_wide(*args, g_w=HYBRID_G_WINDOW),
+                precheck, n)
+    *args, precheck = _prepare_windowed_single_native_words(
+        e_words, r_words, s_words, pub_words, R1_G_WINDOW)
     return (_verify_kernel_windowed_single(*args, curve_name=curve.name,
                                            w=R1_G_WINDOW), precheck, n)
 

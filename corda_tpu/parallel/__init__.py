@@ -15,5 +15,6 @@ from .sharded import (  # noqa: F401
     sharded_merkle_root,
     sharded_verify_batch_ed25519,
     sharded_verify_batch_secp256k1,
+    sharded_verify_batch_secp256k1_words,
     tx_verify_step,
 )

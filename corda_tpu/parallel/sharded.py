@@ -203,7 +203,25 @@ def sharded_verify_batch_ed25519(mesh: Mesh, items, _cache={}):
     return (ok & precheck)[:n]
 
 
-def sharded_verify_batch_secp256k1(mesh: Mesh, items, _cache={}):
+def _k1_mesh_fn(mesh: Mesh, _cache={}):
+    """(jitted hybrid verify fn, replicated G table) per mesh, built once.
+
+    The ~17MB constant-G table is replicated onto every mesh device ONCE,
+    built from the HOST-side table: the single-device arrays baked into
+    prepare's output would otherwise be re-broadcast on every call (their
+    sharding mismatches the replicated in_spec)."""
+    key = ("secp256k1", id(mesh))
+    if key not in _cache:
+        from ..core.crypto.ecmath import SECP256K1
+        rep = jax.NamedSharding(mesh, P())
+        tabs = tuple(jax.device_put(t, rep) for t in
+                     wc_ops._g_window_table_wide(SECP256K1,
+                                                 wc_ops.HYBRID_G_WINDOW))
+        _cache[key] = (sharded_ecdsa_verify_hybrid(mesh), tabs)
+    return _cache[key]
+
+
+def sharded_verify_batch_secp256k1(mesh: Mesh, items):
     """[(pub_point, msg, r, s)] → bool verdicts (B,) via the hybrid GLV
     kernel, batch dp-sharded over ``mesh``."""
     n = len(items)
@@ -212,19 +230,25 @@ def sharded_verify_batch_secp256k1(mesh: Mesh, items, _cache={}):
     padded = items + [items[-1]] * (_pad_to_mesh_bucket(n, mesh) - n)
     *args, precheck = \
         wc_ops.prepare_batch_hybrid_wide(padded, wc_ops.HYBRID_G_WINDOW)
-    key = ("secp256k1", id(mesh))
-    if key not in _cache:
-        # replicate the ~17MB constant-G table onto every mesh device ONCE,
-        # built from the HOST-side table: the single-device arrays baked
-        # into prepare's output would otherwise be re-broadcast on every
-        # call (their sharding mismatches the replicated in_spec)
-        from ..core.crypto.ecmath import SECP256K1
-        rep = jax.NamedSharding(mesh, P())
-        tabs = tuple(jax.device_put(t, rep) for t in
-                     wc_ops._g_window_table_wide(SECP256K1,
-                                                 wc_ops.HYBRID_G_WINDOW))
-        _cache[key] = (sharded_ecdsa_verify_hybrid(mesh), tabs)
-    fn, tabs = _cache[key]
+    fn, tabs = _k1_mesh_fn(mesh)
+    ok = np.asarray(fn(*args[:-3], *tabs))
+    return (ok & precheck)[:n]
+
+
+def sharded_verify_batch_secp256k1_words(mesh: Mesh, e_words, r_words,
+                                         s_words, pub_words):
+    """Word-form sibling of :func:`sharded_verify_batch_secp256k1`: inputs
+    are the native preps' (B, ·) LE u64 rows (the batcher's cached ECDSA
+    prep — see ops.weierstrass.verify_batch_async_words), batch dp-sharded
+    over ``mesh``. Requires wc_ops.words_prep_available."""
+    n = len(e_words)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    e_words, r_words, s_words, pub_words = wc_ops.pad_word_rows(
+        (e_words, r_words, s_words, pub_words), _pad_to_mesh_bucket(n, mesh))
+    *args, precheck = wc_ops._prepare_hybrid_native_words(
+        e_words, r_words, s_words, pub_words, wc_ops.HYBRID_G_WINDOW)
+    fn, tabs = _k1_mesh_fn(mesh)
     ok = np.asarray(fn(*args[:-3], *tabs))
     return (ok & precheck)[:n]
 

@@ -143,6 +143,27 @@ class Counter:
         self.inc(-n)
 
 
+class Gauge:
+    """Settable instantaneous level with a high-water mark.
+
+    Counter tracks net increments; Gauge records observed *levels* and the
+    maximum ever seen — the shape of the batcher's prep-pool concurrency
+    metric, where the high-water mark (how many scheme preps actually
+    overlapped) is the interesting number and the instantaneous value is
+    usually zero by the time anyone snapshots."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0.0
+        self.max_value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = value
+            if value > self.max_value:
+                self.max_value = value
+
+
 class MetricRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -169,6 +190,9 @@ class MetricRegistry:
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
 
+    def settable_gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
     def gauge(self, name: str, fn) -> None:
         with self._lock:
             self._metrics[name] = fn
@@ -186,6 +210,8 @@ class MetricRegistry:
                 out[name] = {"value": m.value}
             elif isinstance(m, Histogram):
                 out[name] = m.snapshot_fields()
+            elif isinstance(m, Gauge):
+                out[name] = {"value": m.value, "max": m.max_value}
             else:
                 out[name] = {"value": m()}
         return out

@@ -5,13 +5,17 @@ Reference analog: the verifier thread-pool seam
 (InMemoryTransactionVerifierService.kt:10-18) — here the policy layer in
 front of the device kernels.
 """
+import threading
+
 import pytest
 
 from corda_tpu.core.crypto import generate_keypair
 from corda_tpu.core.crypto.keys import PublicKey
-from corda_tpu.core.crypto.schemes import ECDSA_SECP256K1_SHA256
+from corda_tpu.core.crypto.schemes import (ECDSA_SECP256K1_SHA256,
+                                           ECDSA_SECP256R1_SHA256,
+                                           EDDSA_ED25519_SHA512)
 from corda_tpu.core.crypto.signatures import Crypto
-from corda_tpu.verifier.batcher import SignatureBatcher
+from corda_tpu.verifier.batcher import SignatureBatcher, _Group, _Pending
 
 KP = generate_keypair(ECDSA_SECP256K1_SHA256, entropy=b"\x61" * 32)
 CONTENT = b"batcher policy test content"
@@ -85,6 +89,109 @@ def test_bulk_submit_verdicts_match_individual():
         futs = b.submit_many([(KP.public, SIG, CONTENT),
                               (KP.public, wrong, CONTENT)])
         assert [f.result(timeout=30) for f in futs] == [True, False]
+    finally:
+        b.close()
+
+
+def test_mixed_drain_preps_schemes_concurrently():
+    """Tentpole pin: ONE drain holding ed25519 + k1 + r1 buckets routes each
+    bucket to its own prep-pool worker — no serial per-bucket _flush loop on
+    the dispatcher thread. With the ed25519 flush wedged on an event, the
+    ECDSA buckets of the SAME drain still prep and resolve."""
+    ed_kp = generate_keypair(EDDSA_ED25519_SHA512, entropy=b"\x71" * 32)
+    r1_kp = generate_keypair(ECDSA_SECP256R1_SHA256, entropy=b"\x72" * 32)
+    content = b"mixed drain"
+    ed_sig = Crypto.sign_with_key(ed_kp, content).bytes
+    k1_sig = Crypto.sign_with_key(KP, content).bytes
+    r1_sig = Crypto.sign_with_key(r1_kp, content).bytes
+
+    release, entered = threading.Event(), threading.Event()
+    # huge crossover: every bucket takes the host route inside _flush — the
+    # pipeline shape under test is identical, with no kernel compiles
+    b = SignatureBatcher(host_crossover=10_000, max_latency_s=0.05)
+    inner = b._run_host
+    ed_id = EDDSA_ED25519_SHA512.scheme_number_id
+
+    def gated_run_host(items):
+        if items[0].key.scheme.scheme_number_id == ed_id:
+            entered.set()
+            assert release.wait(timeout=30)
+        return inner(items)
+
+    b._run_host = gated_run_host   # instance shadow of the staticmethod
+    try:
+        # one submit_many -> one notify -> the dispatcher drains all three
+        # scheme buckets in a single pass
+        ed_fut, k1_fut, r1_fut = b.submit_many([
+            (ed_kp.public, ed_sig, content),
+            (KP.public, k1_sig, content),
+            (r1_kp.public, r1_sig, content),
+        ])
+        assert entered.wait(timeout=30)    # ed25519 prep is live and wedged
+        assert k1_fut.result(timeout=30) is True
+        assert r1_fut.result(timeout=30) is True
+        assert not ed_fut.done()
+        release.set()
+        assert ed_fut.result(timeout=30) is True
+        # the overlap gauge saw >= 2 preps in flight at once
+        assert b.metrics.snapshot()["SigBatcher.PrepActive"]["max"] >= 2
+    finally:
+        release.set()
+        b.close()
+
+
+class _CountingLock:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.acquisitions = 0
+
+    def __enter__(self):
+        self.acquisitions += 1
+        return self._lock.__enter__()
+
+    def __exit__(self, *exc):
+        return self._lock.__exit__(*exc)
+
+
+def test_group_resolve_single_lock_acquire_per_flush():
+    """_resolve batches group fan-in: each group's lock is taken at most
+    ONCE per flush, regardless of how many members the flush carries (it
+    was once per item — 32k acquires for a 32k single-group flush)."""
+    b = SignatureBatcher(use_device=False)
+    try:
+        g = _Group(6)
+        g.lock = _CountingLock()
+        items = [_Pending(KP.public, SIG, CONTENT, group=g, index=i)
+                 for i in range(6)]
+        b._resolve("host", items[:4], [True, False, True, True])
+        assert g.lock.acquisitions == 1
+        assert not g.future.done()
+        b._resolve("host", items[4:], [True, True])
+        assert g.lock.acquisitions == 2
+        assert g.future.result(timeout=5) == [True, False, True, True,
+                                              True, True]
+    finally:
+        b.close()
+
+
+def test_group_mixed_schemes_order_and_isolation():
+    """submit_group across all three schemes: verdicts return in submission
+    order, and a malformed member fails ALONE — its group siblings (in
+    other scheme buckets, resolved by other flushes) still verify."""
+    ed_kp = generate_keypair(EDDSA_ED25519_SHA512, entropy=b"\x73" * 32)
+    r1_kp = generate_keypair(ECDSA_SECP256R1_SHA256, entropy=b"\x74" * 32)
+    content = b"group order"
+    checks = [
+        (ed_kp.public, Crypto.sign_with_key(ed_kp, content).bytes, content),
+        (KP.public, b"\x30\x02\x02\x00", content),        # malformed DER
+        (r1_kp.public, Crypto.sign_with_key(r1_kp, content).bytes, content),
+        (KP.public, Crypto.sign_with_key(KP, content).bytes, content),
+    ]
+    b = SignatureBatcher(max_latency_s=0.01)
+    try:
+        assert b.submit_group(checks).result(timeout=120) == [
+            True, False, True, True]
+        assert b.submit_group([]).result(timeout=5) == []
     finally:
         b.close()
 

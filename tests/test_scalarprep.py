@@ -189,6 +189,79 @@ def test_ed_split_kernel_matches_plain_windowed():
     np.testing.assert_array_equal(split, np.asarray(want))
 
 
+def _der_corpus():
+    """Valid DER signatures plus every malformed shape ecdsa_sig_from_der
+    rejects: truncated, trailing bytes, wrong tags, zero-length ints,
+    negative ints, non-minimal encodings, oversized ints."""
+    rng = random.Random(47)
+    curve = ecmath.SECP256K1
+    sigs = []
+    for _ in range(24):
+        priv = rng.randrange(1, curve.n)
+        r, s = ecmath.ecdsa_sign(curve, priv, rng.randbytes(40))
+        sigs.append(ecmath.ecdsa_sig_to_der(r, s))
+    good = sigs[0]
+    sigs += [
+        b"",                                     # empty
+        b"\x30",                                 # sequence tag alone
+        good[:-1],                               # truncated
+        good + b"\x00",                          # trailing byte
+        b"\x31" + good[1:],                      # wrong outer tag
+        good[:2] + b"\x03" + good[3:],           # wrong INTEGER tag
+        b"\x30\x04\x02\x00\x02\x00",             # zero-length ints
+        b"\x30\x06\x02\x01\x81\x02\x01\x01",     # negative r (high bit)
+        b"\x30\x07\x02\x02\x00\x01\x02\x01\x01",  # non-minimal r
+        b"\x30\x26\x02\x21\x01" + b"\x00" * 32 + b"\x02\x01\x01",  # r > 2^256
+        bytes([good[0], good[1] + 1]) + good[2:] + b"\x00",  # length lies
+    ]
+    return sigs
+
+
+def test_ecdsa_sigs_to_words_matches_der_parser():
+    """The batched DER parse vs the strict per-item parser
+    (ecmath.ecdsa_sig_from_der + ints_to_words): identical accepted set and
+    word rows for every signature whose ints fit 256 bits. Oversized ints
+    (which the strict parser accepts and leaves to the range precheck) and
+    outright malformations both get ok=False + zeroed rows — r = 0 forces
+    the native range precheck to reject, so the VERDICT is identical."""
+    sigs = _der_corpus()
+    r_words, s_words, ok = sp.ecdsa_sigs_to_words(sigs)
+    assert r_words.shape == (len(sigs), 4) and s_words.shape == (len(sigs), 4)
+    for i, der in enumerate(sigs):
+        try:
+            r, s = ecmath.ecdsa_sig_from_der(der)
+            accept = max(r, s) < 1 << 256
+        except Exception:
+            accept = False
+        if not accept:
+            assert not ok[i], f"sig {i}: batched parse accepted"
+            assert not r_words[i].any() and not s_words[i].any()
+            continue
+        assert ok[i], f"sig {i}: batched parse rejected, strict accepted"
+        np.testing.assert_array_equal(r_words[i], sp.ints_to_words([r])[0])
+        np.testing.assert_array_equal(s_words[i], sp.ints_to_words([s])[0])
+    assert ok[:24].all() and not ok[24:].any()
+
+
+def test_pub_row_cache_matches_decompress():
+    """keys.sec1_pub_row_cached vs the bigint decompress: same affine point
+    as LE u64 words, None for undecodable encodings, and cache hits return
+    the identical row."""
+    from corda_tpu.core.crypto.keys import sec1_compress, sec1_pub_row_cached
+    rng = random.Random(48)
+    for curve in (ecmath.SECP256K1, ecmath.SECP256R1):
+        for _ in range(8):
+            pt = curve.mul(rng.randrange(1, curve.n), curve.g)
+            enc = sec1_compress(curve, pt)
+            row = sec1_pub_row_cached(curve, enc)
+            want = np.frombuffer(pt[0].to_bytes(32, "little")
+                                 + pt[1].to_bytes(32, "little"), dtype="<u8")
+            np.testing.assert_array_equal(row, want)
+            assert sec1_pub_row_cached(curve, enc) is row   # LRU hit
+        assert sec1_pub_row_cached(curve, b"\x02" + b"\xff" * 32) is None
+        assert sec1_pub_row_cached(curve, b"\x09" * 33) is None
+
+
 def test_k1_verify_through_native_prep():
     """End-to-end: verify_batch (which routes through the native prep when
     available) accepts valid signatures and rejects tampered ones."""

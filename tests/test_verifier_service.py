@@ -106,6 +106,53 @@ def test_tpu_service_full_path(services):
     svc.shutdown()
 
 
+def test_verify_signed_submits_one_group_per_tx(services):
+    """Acceptance pin: the TPU service path rides submit_group — ONE future
+    per transaction's signature set, never per-signature submit_many
+    futures (~25µs of Future allocation each)."""
+    svc = TpuTransactionVerifierService()
+    calls = []
+    orig = svc.batcher.submit_group
+
+    def spy(checks, ctx=None):
+        calls.append(len(checks))
+        return orig(checks, ctx=ctx)
+
+    def reject(*a, **k):
+        raise AssertionError("verify_signed must not use submit_many")
+
+    svc.batcher.submit_group = spy
+    svc.batcher.submit_many = reject
+    try:
+        stx = make_issue_stx(services)
+        assert svc.verify_signed(stx, services).result(timeout=120) is None
+        assert calls == [len(stx.sigs)]
+    finally:
+        svc.shutdown()
+
+
+def test_verify_signed_on_closed_batcher_returns_failed_future(services):
+    """Span-leak fix: if the batcher rejects the submission (closed), the
+    caller must get a FAILED FUTURE — verify_signed's contract is async —
+    and the root tx.verify span must still be finished, not leaked."""
+    from corda_tpu.observability import disable_tracing, enable_tracing
+    tracer = enable_tracing()
+    svc = TpuTransactionVerifierService()
+    try:
+        stx = make_issue_stx(services)
+        svc.batcher.close()
+        fut = svc.verify_signed(stx, services)
+        assert fut.done()
+        with pytest.raises(RuntimeError, match="closed"):
+            fut.result(timeout=5)
+        # an unfinished span never reaches the ring: its presence IS the
+        # proof that root.finish() ran on the failure path
+        assert "tx.verify" in {s["name"] for s in tracer.spans()}
+    finally:
+        disable_tracing()
+        svc.shutdown()
+
+
 def test_make_verifier_service_seam():
     assert isinstance(make_verifier_service("InMemory"),
                       InMemoryTransactionVerifierService)
