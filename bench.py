@@ -18,7 +18,10 @@ Env knobs:
   CORDA_TPU_BENCH_N       batch size (default 32768; use 256 to smoke-test)
   CORDA_TPU_BENCH_UNIQUE  1 → sign a fully-unique batch (no tiling) for the
                           gather-locality A/B (VERDICT r4 weak #6); slow
-                          (pure-Python signing), meant for one-off runs
+                          (pure-Python signing), meant for one-off runs.
+                          Covers every scheme incl. secp256r1 (make_items
+                          takes the curve), so the half-gcd split path's
+                          per-item windows/tables get the same A/B.
 
 Flags:
   --smoke    tiny-batch wiring check: exercises the FULL service path
@@ -135,15 +138,29 @@ def device_rate(items) -> float:
         wc_ops._verify_kernel_hybrid_wide, g_w=wc_ops.HYBRID_G_WINDOW))
 
 
-def r1_device_rate(items) -> float:
+#: Doublings per verify in the production r1 kernel: the half-gcd split
+#: ladder runs 8 outer steps × 16 bits with step 0 peeled (128 − 4), vs
+#: 252 for the retired full-width windowed ladder.
+R1_DOUBLINGS_PER_OP = 124.0
+
+
+def r1_device_rate(items) -> tuple[float, float]:
+    """(verifies/s, halfgcd fallback %) for the r1 half-gcd split kernel.
+    The benchmark corpus is honestly-signed, so the fallback rate should
+    be 0.0 (r + n < p has ~2^-64 probability for honest r) — the field is
+    emitted so a regression in the split prep shows up in the artifact."""
     import functools
     kitems = [(pub, msg, r, s) for _, pub, msg, r, s in items]
-    *args, pre = wc_ops.prepare_batch_windowed_single(
+    wc_ops.r1_split_stats(reset=True)
+    *args, pre, forced = wc_ops.prepare_batch_r1_split(
         ecmath.SECP256R1, kitems, wc_ops.R1_G_WINDOW)
-    assert np.asarray(pre).all()
-    return _kernel_rate(args, functools.partial(
-        wc_ops._verify_kernel_windowed_single, curve_name="secp256r1",
+    stats = wc_ops.r1_split_stats()
+    fallback_pct = 100.0 * stats["fallback"] / max(1, stats["items"])
+    assert np.asarray(pre).all() and not forced.any()
+    rate = _kernel_rate(args, functools.partial(
+        wc_ops._verify_kernel_r1_split, curve_name="secp256r1",
         w=wc_ops.R1_G_WINDOW))
+    return rate, fallback_pct
 
 
 def ed_device_rate(items) -> float:
@@ -262,11 +279,11 @@ def main() -> None:
     if SMOKE:
         # host-crossover route only: no device kernel compiles on the
         # wiring check; kernel-rate fields keep their slots at 0.0
-        dev = ed_dev = r1_dev = 0.0
+        dev = ed_dev = r1_dev = r1_fallback_pct = 0.0
     else:
         dev = device_rate(items)
         ed_dev = ed_device_rate(ed_items)
-        r1_dev = r1_device_rate(r1_items)
+        r1_dev, r1_fallback_pct = r1_device_rate(r1_items)
     (k1_rate, ed_rate, r1_rate, mixed_rate, p50_ms, p50_1k_ms, stages,
      overlap) = service_metrics(items, ed_items, r1_items)
     host = host_baseline_rate(items[: min(128, BATCH)])
@@ -277,6 +294,8 @@ def main() -> None:
         "vs_baseline": round(dev / host, 3),
         "ed25519_verifies_per_sec_per_chip": round(ed_dev, 1),
         "secp256r1_verifies_per_sec_per_chip": round(r1_dev, 1),
+        "r1_halfgcd_fallback_pct": round(r1_fallback_pct, 4),
+        "r1_doublings_per_op": R1_DOUBLINGS_PER_OP,
         "service_path_verifies_per_sec": round(k1_rate, 1),
         "ed25519_service_path_verifies_per_sec": round(ed_rate, 1),
         "secp256r1_service_path_verifies_per_sec": round(r1_rate, 1),

@@ -16,6 +16,7 @@ Word convention: multiword integers are little-endian u64 arrays; a
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 
 import numpy as np
@@ -26,14 +27,62 @@ _CANDIDATES = [
     os.path.join(_HERE, "libscalarmath.so"),
 ]
 
+#: ABI gate: the .so and this module move together (docs/PERFORMANCE.md
+#: "sharp edges").  Version 3 added sm_r1_halfgcd / sm_r1_prep_hg /
+#: sm_r1p_mulfast (the secp256r1 half-gcd split ladder).
+SM_VERSION = 3
+
+_log = logging.getLogger(__name__)
+
 _U64P = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
 _U16P = np.ctypeslib.ndpointer(dtype=np.uint16, flags="C_CONTIGUOUS")
 _U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
 _I32P = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
 
 
-def _load():
-    for path in _CANDIDATES:
+def _bind(lib) -> None:
+    """Attach argtypes for every export of the expected ABI version."""
+    lib.sm_mulmod.restype = ctypes.c_int
+    lib.sm_mulmod.argtypes = [ctypes.c_int, _U64P, _U64P, _U64P]
+    lib.sm_mod512.restype = ctypes.c_int
+    lib.sm_mod512.argtypes = [ctypes.c_int, _U64P, _U64P]
+    lib.sm_glv.restype = ctypes.c_int
+    lib.sm_glv.argtypes = [_U64P, _U8P, _U64P, _U64P]
+    lib.sm_r1_halfgcd.restype = ctypes.c_int
+    lib.sm_r1_halfgcd.argtypes = [_U64P, _U8P, _U64P, _U64P]
+    lib.sm_r1p_mulfast.restype = ctypes.c_int
+    lib.sm_r1p_mulfast.argtypes = [_U64P, _U64P, _U64P]
+    lib.sm_k1_prep.restype = ctypes.c_int
+    lib.sm_k1_prep.argtypes = [
+        ctypes.c_int64, _U64P, _U64P, _U64P, _U64P,
+        _I32P, _U8P, _U16P, _U16P, _U16P, _U16P, _U16P,
+        _U8P, _U8P, _U64P]
+    lib.sm_r1_prep.restype = ctypes.c_int
+    lib.sm_r1_prep.argtypes = [
+        ctypes.c_int64, _U64P, _U64P, _U64P, _U64P,
+        _I32P, _U8P, _U16P, _U16P, _U16P,
+        _U8P, _U8P, _U64P]
+    lib.sm_r1_prep_hg.restype = ctypes.c_int
+    lib.sm_r1_prep_hg.argtypes = [
+        ctypes.c_int64, _U64P, _U64P, _U64P, _U64P,
+        _I32P, _U8P, _U16P, _U16P, _U16P,
+        _U8P, _U8P, _U64P]
+    lib.sm_ed_prep.restype = ctypes.c_int
+    lib.sm_ed_prep.argtypes = [
+        ctypes.c_int64, _U64P, _U64P, _I32P, _I32P, _U8P, _U8P]
+    lib.sm_ed_prep_plain.restype = ctypes.c_int
+    lib.sm_ed_prep_plain.argtypes = [
+        ctypes.c_int64, _U64P, _U64P, _I32P, _U8P, _U8P]
+
+
+def _load(candidates=None, expected: int = SM_VERSION):
+    """Load the first candidate .so whose sm_version matches ``expected``.
+
+    A version mismatch (stale .so after a repo update — the graceful-degrade
+    path pinned by tests/test_scalarprep.py) is LOUD: the pure-Python prep
+    is bit-identical but an order of magnitude slower, so silence here
+    would read as a performance regression, not a build drift."""
+    for path in (candidates if candidates is not None else _CANDIDATES):
         if not os.path.exists(path):
             continue
         try:
@@ -41,30 +90,14 @@ def _load():
         except OSError:
             continue
         lib.sm_version.restype = ctypes.c_int
-        if lib.sm_version() != 2:
+        got = lib.sm_version()
+        if got != expected:
+            _log.warning(
+                "stale libscalarmath.so at %s (sm_version %d, need %d): "
+                "falling back to the pure-Python scalar prep — rebuild with "
+                "`make -C native libscalarmath.so`", path, got, expected)
             continue
-        lib.sm_mulmod.restype = ctypes.c_int
-        lib.sm_mulmod.argtypes = [ctypes.c_int, _U64P, _U64P, _U64P]
-        lib.sm_mod512.restype = ctypes.c_int
-        lib.sm_mod512.argtypes = [ctypes.c_int, _U64P, _U64P]
-        lib.sm_glv.restype = ctypes.c_int
-        lib.sm_glv.argtypes = [_U64P, _U8P, _U64P, _U64P]
-        lib.sm_k1_prep.restype = ctypes.c_int
-        lib.sm_k1_prep.argtypes = [
-            ctypes.c_int64, _U64P, _U64P, _U64P, _U64P,
-            _I32P, _U8P, _U16P, _U16P, _U16P, _U16P, _U16P,
-            _U8P, _U8P, _U64P]
-        lib.sm_r1_prep.restype = ctypes.c_int
-        lib.sm_r1_prep.argtypes = [
-            ctypes.c_int64, _U64P, _U64P, _U64P, _U64P,
-            _I32P, _U8P, _U16P, _U16P, _U16P,
-            _U8P, _U8P, _U64P]
-        lib.sm_ed_prep.restype = ctypes.c_int
-        lib.sm_ed_prep.argtypes = [
-            ctypes.c_int64, _U64P, _U64P, _I32P, _I32P, _U8P, _U8P]
-        lib.sm_ed_prep_plain.restype = ctypes.c_int
-        lib.sm_ed_prep_plain.argtypes = [
-            ctypes.c_int64, _U64P, _U64P, _I32P, _U8P, _U8P]
+        _bind(lib)
         return lib
     return None
 
@@ -183,6 +216,60 @@ def glv(k: int) -> tuple[int, int]:
     return (-k1 if negs[0] else k1), (-k2 if negs[1] else k2)
 
 
+def r1p_mulfast(a: int, b: int) -> int:
+    """Native seam: a*b mod p256 via the Solinas fast reduction (the
+    [v2]R ladder's field mul — differential-tested vs Barrett/bigint)."""
+    aw = ints_to_words([a])
+    bw = ints_to_words([b])
+    r = np.zeros((1, 4), dtype=np.uint64)
+    rc = _LIB.sm_r1p_mulfast(aw, bw, r)
+    assert rc == 0, rc
+    return int.from_bytes(r.tobytes(), "little")
+
+
+#: secp256r1 group order (duplicated from ecmath.SECP256R1 to keep this
+#: module import-light — the value is pinned by test_scalarprep).
+R1_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+
+
+def r1_halfgcd_py(k: int) -> tuple[bool, int, int] | None:
+    """Pure-Python reference for the half-gcd split (Antipa et al., SAC
+    2005): extended Euclid on (n, k) stopped at the first remainder below
+    2^128.  Returns (neg1, v1, v2) with k*v2 ≡ (-v1 if neg1 else v1)
+    (mod n), 0 <= v1 < 2^128, 0 < v2 < 2^128 — bit-identical to the
+    native sm_r1_halfgcd — or None when the split degenerates (k = 0 or
+    k >= n; the in-range legs can never reach 2^128: |t_i| <= n/r_{i-1}
+    with r_{i-1} >= 2^128).  Signs in the EEA t-sequence strictly
+    alternate, so only magnitudes are tracked with one parity bit."""
+    if k <= 0 or k >= R1_N:
+        return None
+    r0, r1 = R1_N, k
+    m0, m1 = 0, 1
+    s_pos = True                     # sign of the t attached to r1
+    while r1 >> 128:
+        q, rem = divmod(r0, r1)
+        r0, r1 = r1, rem
+        m0, m1 = m1, m0 + q * m1
+        s_pos = not s_pos
+    if r1 == 0 or m1 == 0 or (m1 >> 128):
+        return None
+    return (not s_pos), r1, m1
+
+
+def r1_halfgcd(k: int) -> tuple[bool, int, int] | None:
+    """Native seam for the half-gcd split; same contract as
+    :func:`r1_halfgcd_py`."""
+    kw = ints_to_words([k])
+    neg1 = np.zeros(1, dtype=np.uint8)
+    v1 = np.zeros(2, dtype=np.uint64)
+    v2 = np.zeros(2, dtype=np.uint64)
+    rc = _LIB.sm_r1_halfgcd(kw, neg1, v1, v2)
+    if rc != 0:
+        return None
+    return (bool(neg1[0]), int.from_bytes(v1.tobytes(), "little"),
+            int.from_bytes(v2.tobytes(), "little"))
+
+
 # ---------------------------------------------------------------------------
 # Batch preps
 # ---------------------------------------------------------------------------
@@ -231,6 +318,31 @@ def r1_prep(e_words, r_words, s_words, pub_words):
     if rc != 0:
         raise RuntimeError(f"sm_r1_prep failed: {rc}")
     return (g_idx, q_digits, q_x, q_y, r_limbs, rn_ok, precheck.astype(bool))
+
+
+def r1_prep_hg(e_words, r_words, s_words, pub_words):
+    """secp256r1 half-gcd split prep (the PR-3 fast path; wire layout in
+    scalarmath.cpp sm_r1_prep_hg).  Returns (g_idx(16,B) i32 — row 2j =
+    t_hi window j, row 2j+1 = t_lo window j; q_digits(32,B) u8 4-bit |v1|
+    digits; q_x, q_y (B,16) u16 sign-adjusted Q; xd_limbs (B,16) u16
+    x([v2]R); hg_ok (B) u8; precheck (B) bool)."""
+    n = len(e_words)
+    g_idx = np.empty((16, n), dtype=np.int32)
+    q_digits = np.empty((32, n), dtype=np.uint8)
+    q_x = np.empty((n, 16), dtype=np.uint16)
+    q_y = np.empty((n, 16), dtype=np.uint16)
+    xd_limbs = np.empty((n, 16), dtype=np.uint16)
+    hg_ok = np.empty(n, dtype=np.uint8)
+    precheck = np.empty(n, dtype=np.uint8)
+    work = np.empty((5 * n, 4), dtype=np.uint64)
+    rc = _LIB.sm_r1_prep_hg(
+        n, np.ascontiguousarray(e_words), np.ascontiguousarray(r_words),
+        np.ascontiguousarray(s_words), np.ascontiguousarray(pub_words),
+        g_idx, q_digits, q_x, q_y, xd_limbs, hg_ok, precheck, work)
+    if rc != 0:
+        raise RuntimeError(f"sm_r1_prep_hg failed: {rc}")
+    return (g_idx, q_digits, q_x, q_y, xd_limbs, hg_ok,
+            precheck.astype(bool))
 
 
 def ed_prep(h_words, s_words):

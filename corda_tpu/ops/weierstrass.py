@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -739,19 +740,21 @@ def _g_window_table_wide(curve: WeierstrassCurve, w: int):
 _G_TABLES_1S: dict[tuple, tuple] = {}
 
 
-def _g_window_table_single(curve: WeierstrassCurve, w: int):
+def _g_window_table_single(curve: WeierstrassCurve, w: int, shift: int = 0):
     """Single-scalar constant-G window table for curves WITHOUT an
     endomorphism (secp256r1): u16 affine X/Y arrays of shape (2^w, NLIMB)
-    plus a u8 validity flag (row 0 = identity). Entry wa = wa·G.
+    plus a u8 validity flag (row 0 = identity). Entry wa = wa·B where the
+    base B is [2^shift]G — shift=0 is the plain G table, shift=128 the
+    high-half table the half-gcd split ladder pairs with it.
 
     Built as a JACOBIAN host chain (no inversion per add) landed affine by
     ONE Montgomery batch inversion — 2^16 rows in ~1s."""
-    key = (curve.name, w)
+    key = (curve.name, w, shift)
     if key in _G_TABLES_1S:
         return _G_TABLES_1S[key]
     p = curve.p
     a = curve.a % p
-    gx, gy = curve.g
+    gx, gy = curve.mul(1 << shift, curve.g) if shift else curve.g
     span = 1 << w
 
     def jac_dbl(X1, Y1, Z1):
@@ -806,10 +809,11 @@ def _g_window_table_single(curve: WeierstrassCurve, w: int):
     return tab
 
 
-def g_window_table_single_device(curve: WeierstrassCurve, w: int):
+def g_window_table_single_device(curve: WeierstrassCurve, w: int,
+                                 shift: int = 0):
     return F.device_table_cache(
-        ("g_single", curve.name, w),
-        lambda: _g_window_table_single(curve, w))
+        ("g_single", curve.name, w, shift),
+        lambda: _g_window_table_single(curve, w, shift))
 
 
 #: Constant-G window width for the single-scalar windowed ladder (r1).
@@ -940,6 +944,259 @@ def _prepare_windowed_single_python(curve: WeierstrassCurve, items,
     return (jnp.asarray(g_idx), jnp.asarray(q_digits),
             _points_to_limbs_affine(pubs), r_limbs, rn_ok,
             *g_window_table_single_device(curve, w), precheck)
+
+
+# ---------------------------------------------------------------------------
+# Half-gcd split path (secp256r1): [t_lo]G + [t_hi]G' + [|v1|](±Q) ?= [v2]R
+# ---------------------------------------------------------------------------
+#
+# Antipa et al. (SAC 2005): the extended Euclid run on (n, u2), stopped at
+# the first remainder below 2^128, yields v1, v2 < 2^128 with
+# u2·v2 ≡ ±v1 (mod n). Multiplying the ECDSA equation X = [u1]G + [u2]Q by
+# v2 gives [t]G ± [v1]Q = [v2]X with t = v2·u1 mod n — t is full-width, but
+# splitting it at 2^128 against a second constant table G' = [2^128]G keeps
+# every DOUBLING run at 128 bits: 124 doublings instead of the windowed
+# ladder's 252. The host decompresses R = (r, y) and computes
+# x_D = x([v2]R) (one Jacobian ladder + ONE batch inversion per batch);
+# the device accepts iff x(W2) == x_D projectively — parity-insensitive,
+# and sound because v2 is invertible mod the prime n, so
+# W2 = [v2]X = ±[v2]R ⟺ X = ±R ⟺ x(X) = r.
+#
+# Items where the split can't stand in for the old two-candidate check
+# fall back to the HOST oracle, masked per-item (hg_ok=0): r + n < p (the
+# second x-candidate exists — ~2^-64 for honest r since p − n ≈ 2^192, but
+# craftable), r not a quadratic-residue x-coordinate, or a defensive
+# half-gcd bound failure. Precheck failures keep hg_ok=1: their verdict is
+# already False and their zeroed windows make W2 = ∞ on device.
+
+_R1_HG_STATS = {"items": 0, "fallback": 0}
+_R1_HG_LOCK = threading.Lock()
+
+
+def _record_hg_stats(items: int, fallback: int) -> None:
+    with _R1_HG_LOCK:
+        _R1_HG_STATS["items"] += int(items)
+        _R1_HG_STATS["fallback"] += int(fallback)
+
+
+def r1_split_stats(reset: bool = False) -> dict:
+    """Process-cumulative half-gcd split counters: items prepped through
+    the split path and how many fell back to the host oracle (hg_ok=0).
+    bench.py reads (and resets) these for r1_halfgcd_fallback_pct."""
+    with _R1_HG_LOCK:
+        out = dict(_R1_HG_STATS)
+        if reset:
+            _R1_HG_STATS["items"] = 0
+            _R1_HG_STATS["fallback"] = 0
+    return out
+
+
+def _r1_host_verify_scalars(curve: WeierstrassCurve, pub, e_raw: int,
+                            r: int, s: int) -> bool:
+    """ecmath.ecdsa_verify from the already-hashed digest int (the words
+    path never sees the message). Must stay verdict-identical to the
+    oracle — pinned in tests/test_scalarprep.py."""
+    n = curve.n
+    if not (1 <= r < n and 1 <= s <= n // 2):
+        return False
+    if pub is None or not curve.is_on_curve(pub):
+        return False
+    e = e_raw % n
+    w = pow(s, n - 2, n)
+    X = curve.add(curve.mul(e * w % n, curve.g),
+                  curve.mul(r * w % n, pub))
+    if X is None:
+        return False
+    return X[0] % n == r
+
+
+def r1_split_ladder(g_idx, q_digits, Q, gtab_lo, gtab_hi,
+                    curve: WeierstrassCurve, w: int):
+    """W2 = [t_lo]G + [t_hi]G' + [|v1|](±Q) with every scalar < 2^128: per
+    outer step, ``w`` bits — w doublings, w/4 Q adds (4-bit windows over
+    the 16-entry {0..15}Q table) and TWO mixed G adds, one gathered from
+    the G' = [2^128]G table (high half of t) and one from the plain G
+    table (low half). 128/w outer steps; step 0 peeled ⇒ 128 − w
+    doublings total (124 at w = 16) vs the full-width ladder's 252.
+
+    ``g_idx``: (128/w, 2, B) — [:, 0] = t_hi windows, [:, 1] = t_lo;
+    ``q_digits``: (128/w, w/4, B) 4-bit |v1| digits; ``Q``: affine (x, y)
+    limb pair, y already sign-adjusted for neg1 on host."""
+    lo_x, lo_y, lo_ok = gtab_lo
+    hi_x, hi_y, hi_ok = gtab_hi
+    assert (g_idx.shape[0] * w == 128 and g_idx.shape[1] == 2
+            and q_digits.shape[1] * 4 == w), (g_idx.shape, q_digits.shape, w)
+    assert lo_x.shape[0] == 1 << w and hi_x.shape[0] == 1 << w, \
+        (lo_x.shape, hi_x.shape, w)
+    q_tab = _q_table_single(Q, curve)
+
+    def q_addend(dig):
+        return select_tree(q_tab, dig)
+
+    def g_add(acc, gi, tab_x, tab_y, tab_ok):
+        q2 = (tab_x[gi].astype(jnp.uint64), tab_y[gi].astype(jnp.uint64))
+        added = _madd_w(acc, q2, curve)
+        ok = tab_ok[gi].astype(jnp.bool_)
+        return tuple(F.select(ok, new_c, acc_c)
+                     for new_c, acc_c in zip(added, acc))
+
+    def q_step(acc, dig):
+        acc = dbl(dbl(dbl(dbl(acc, curve), curve), curve), curve)
+        return add(acc, q_addend(dig), curve), None
+
+    def step(acc, ins):
+        gi, digs = ins
+        acc, _ = jax.lax.scan(q_step, acc, digs)
+        acc = g_add(acc, gi[0], hi_x, hi_y, hi_ok)
+        return g_add(acc, gi[1], lo_x, lo_y, lo_ok), None
+
+    # peel step 0 (accumulator starts as the identity)
+    acc = q_addend(q_digits[0][0])
+    acc, _ = jax.lax.scan(q_step, acc, q_digits[0][1:])
+    acc = g_add(acc, g_idx[0][0], hi_x, hi_y, hi_ok)
+    acc = g_add(acc, g_idx[0][1], lo_x, lo_y, lo_ok)
+    acc, _ = jax.lax.scan(step, acc, (g_idx[1:], q_digits[1:]))
+    return acc
+
+
+def verify_core_r1_split(g_idx, q_digits, Q, xd_limbs,
+                         lo_x, lo_y, lo_ok, hi_x, hi_y, hi_ok,
+                         curve_name: str, w: int):
+    """Device accept for the split form: W2 ≠ ∞ ∧ x(W2) == x_D checked
+    projectively (X == x_D·Z). Single candidate — the r+n twin is a
+    host-fallback condition, not a device branch. Zero-window items land
+    on W2 = ∞ and reject here; their verdict comes from precheck/forced."""
+    g_idx = jnp.asarray(g_idx, jnp.int32)
+    q_digits = jnp.asarray(q_digits, jnp.uint64)
+    Q = tuple(jnp.asarray(c, jnp.uint64) for c in Q)
+    xd = jnp.asarray(xd_limbs, jnp.uint64)
+    curve = CURVES[curve_name]
+    X, Y, Z = r1_split_ladder(g_idx, q_digits, Q, (lo_x, lo_y, lo_ok),
+                              (hi_x, hi_y, hi_ok), curve, w)
+    p = curve.p
+    nonzero = ~F.is_zero(Z, p)
+    ok = jnp.all(F.canon(X, p) == F.canon(F.mul(xd, Z, p), p), axis=-1)
+    return nonzero & ok
+
+
+_verify_kernel_r1_split = jax.jit(
+    verify_core_r1_split, static_argnames=("curve_name", "w"))
+
+
+def prepare_batch_r1_split(curve: WeierstrassCurve, items,
+                           w: int = R1_G_WINDOW):
+    """Host prep for the half-gcd split kernel. Returns
+    ``(*kernel_args, precheck_eff, forced)`` where precheck_eff masks out
+    both structural failures AND hg_ok=0 fallbacks, and ``forced`` carries
+    the host-oracle verdicts for the fallback items (False elsewhere) —
+    callers combine as ``(dev & precheck_eff) | forced``."""
+    from . import scalarprep as sp
+    if w == 16 and curve.name == "secp256r1" and sp.available():
+        return _prepare_r1_split_native_words(*_items_to_words(items), w)
+    return _prepare_r1_split_python(curve, items, w)
+
+
+def _r1_split_pack(curve, g_idx, q_digits, q_pts, xd_limbs, hg_ok,
+                   precheck, forced, w: int):
+    """Shared tail of both split preps: fallback accounting, window
+    reshapes, and the two G tables (plain G and G' = [2^128]G)."""
+    B = len(precheck)
+    hg = np.asarray(hg_ok, dtype=bool)
+    _record_hg_stats(B, int((precheck & ~hg).sum()))
+    return (jnp.asarray(g_idx.reshape(128 // w, 2, B)),
+            jnp.asarray(q_digits.reshape(128 // w, w // 4, B)),
+            q_pts, jnp.asarray(xd_limbs),
+            *g_window_table_single_device(curve, w),
+            *g_window_table_single_device(curve, w, 128),
+            precheck & hg, forced)
+
+
+def _words_row_int(words, i: int) -> int:
+    return int.from_bytes(np.ascontiguousarray(words[i]).tobytes(), "little")
+
+
+def _prepare_r1_split_native_words(e_words, r_words, s_words, pub_words,
+                                   w: int):
+    """Word-form core of the native half-gcd prep: the whole scalar layer
+    (precheck, batch s-inversion, half-gcd, t-split windows, R decompress,
+    the [v2]R ladder and its batch inversion) runs in
+    native/scalarmath.cpp — bit-identical to _prepare_r1_split_python
+    (tests/test_scalarprep.py)."""
+    from . import scalarprep as sp
+    curve = CURVES["secp256r1"]
+    (g_idx, q_digits, q_x, q_y, xd_limbs, hg_ok,
+     precheck) = sp.r1_prep_hg(e_words, r_words, s_words, pub_words)
+    fb = precheck & ~hg_ok.astype(bool)
+    forced = np.zeros(len(precheck), dtype=bool)
+    for i in np.nonzero(fb)[0]:
+        row = np.ascontiguousarray(pub_words[i]).tobytes()
+        pub = (int.from_bytes(row[:32], "little"),
+               int.from_bytes(row[32:], "little"))
+        forced[i] = _r1_host_verify_scalars(
+            curve, pub, _words_row_int(e_words, i),
+            _words_row_int(r_words, i), _words_row_int(s_words, i))
+    return _r1_split_pack(curve, g_idx, q_digits,
+                          (jnp.asarray(q_x), jnp.asarray(q_y)), xd_limbs,
+                          hg_ok, precheck, forced, w)
+
+
+def _prepare_r1_split_python(curve: WeierstrassCurve, items,
+                             w: int = R1_G_WINDOW):
+    """Pure-Python mirror of sm_r1_prep_hg — bit-identical wire arrays
+    (same substitutions, zeroing, window layout and sign handling), so a
+    stale/missing native library degrades in speed only."""
+    from . import scalarprep as sp
+    p, n, b = curve.p, curve.n, curve.b
+    precheck, pubs, u1s, u2s, r0, _ = _precheck_and_scalars(curve, items)
+    B = len(items)
+    g_idx = np.zeros((2 * (128 // w), B), dtype=np.int32)
+    q_digits = np.zeros((128 // R1_Q_WINDOW, B), dtype=np.uint8)
+    hg_ok = np.ones(B, dtype=np.uint8)
+    qys, xds = [], []
+    mask16 = (1 << w) - 1
+    for i, (pub, u1, u2, r) in enumerate(zip(pubs, u1s, u2s, r0)):
+        hg, neg1, v1, v2, tt, y_r = True, False, 0, 0, 0, None
+        if precheck[i]:
+            dec = sp.r1_halfgcd_py(u2)
+            if dec is None:
+                hg = False
+            else:
+                neg1, v1, v2 = dec
+                tt = v2 * u1 % n
+            if r + n < p:
+                hg = False
+            if hg:
+                z = (r * r % p * r - 3 * r + b) % p
+                y_r = pow(z, (p + 1) // 4, p)
+                if y_r * y_r % p != z:
+                    hg = False
+        emit = bool(precheck[i]) and hg
+        hg_ok[i] = 1 if hg else 0
+        if emit:
+            t_hi, t_lo = tt >> 128, tt & ((1 << 128) - 1)
+            for j in range(128 // w):
+                sh = w * (128 // w - 1 - j)
+                g_idx[2 * j, i] = (t_hi >> sh) & mask16
+                g_idx[2 * j + 1, i] = (t_lo >> sh) & mask16
+            for j in range(128 // R1_Q_WINDOW):
+                q_digits[j, i] = (v1 >> (4 * (31 - j))) & 0xF
+            D = curve.mul(v2, (r, y_r))
+            xds.append(D[0])
+        else:
+            xds.append(0)
+        qys.append((p - pub[1]) % p if (emit and neg1) else pub[1])
+    q_pts = (jnp.asarray(F.to_limbs([q[0] for q in pubs]).astype(np.uint16)),
+             jnp.asarray(F.to_limbs(qys).astype(np.uint16)))
+    xd_limbs = F.to_limbs(xds).astype(np.uint16)
+    forced = np.zeros(B, dtype=bool)
+    for i in np.nonzero(precheck & ~hg_ok.astype(bool))[0]:
+        # precheck already validated the item; the oracle verdict is just
+        # X = [u1]G + [u2]Q ≠ ∞ ∧ x(X) ≡ r (mod n)
+        X = curve.add(curve.mul(u1s[i], curve.g),
+                      curve.mul(u2s[i], pubs[i]))
+        forced[i] = X is not None and X[0] % n == r0[i]
+    return _r1_split_pack(curve, g_idx, q_digits, q_pts, xd_limbs, hg_ok,
+                          precheck, forced, w)
 
 
 def g_window_table_device(curve: WeierstrassCurve, w: int):
@@ -1221,10 +1478,13 @@ def verify_batch(curve: WeierstrassCurve,
     Pads to a power-of-two bucket (replicating the last item) so the device
     kernel compiles once per bucket size. ``mode``:
     - "auto": the fastest measured path — "hybrid" (GLV) for secp256k1,
-      "windowed" (constant-G table, no endomorphism) otherwise.
+      "halfgcd" for secp256r1, "windowed" otherwise.
     - "hybrid": GLV half-length ladder with the constant-G gather table.
-    - "windowed": single-scalar constant-G windows + 2-bit Q windows
-      (windowed_ladder_single — the r1 production path).
+    - "halfgcd": the Antipa split ladder — 128-bit legs against the G and
+      [2^128]G tables, host [v2]R comparand, per-item host fallback
+      (r1_split_ladder — the r1 production path).
+    - "windowed": single-scalar constant-G windows + 4-bit Q windows
+      (windowed_ladder_single — kept as the r1 A/B reference path).
     - "glv": the all-select GLV ladder (kept for differential testing —
       measured at parity with plain: the 15-select tree eats the saved ops).
     - "plain": the 256-bit two-scalar Shamir ladder.
@@ -1234,11 +1494,19 @@ def verify_batch(curve: WeierstrassCurve,
         return np.zeros(0, dtype=bool)
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
     if mode == "auto":
-        mode = "hybrid" if curve.name == "secp256k1" else "windowed"
-    if mode not in ("plain", "glv", "hybrid", "windowed"):
+        mode = {"secp256k1": "hybrid",
+                "secp256r1": "halfgcd"}.get(curve.name, "windowed")
+    if mode not in ("plain", "glv", "hybrid", "windowed", "halfgcd"):
         raise ValueError(f"unknown verify mode {mode!r}")
     if mode in ("glv", "hybrid") and curve.name != "secp256k1":
         raise ValueError(f"mode {mode!r} requires secp256k1")
+    if mode == "halfgcd" and curve.name != "secp256r1":
+        raise ValueError(f"mode {mode!r} requires secp256r1")
+    if mode == "halfgcd":
+        *args, precheck, forced = prepare_batch_r1_split(curve, padded)
+        ok = np.asarray(_verify_kernel_r1_split(
+            *args, curve_name=curve.name, w=R1_G_WINDOW))
+        return ((ok & precheck) | forced)[:n]
     if mode == "hybrid":
         *args, precheck = prepare_batch_hybrid_wide(padded, HYBRID_G_WINDOW)
         ok = np.asarray(_verify_kernel_hybrid_wide(*args,
@@ -1272,6 +1540,10 @@ def verify_batch_async(curve: WeierstrassCurve,
         *args, precheck = prepare_batch_hybrid_wide(padded, HYBRID_G_WINDOW)
         return (_verify_kernel_hybrid_wide(*args, g_w=HYBRID_G_WINDOW),
                 precheck, n)
+    if curve.name == "secp256r1":
+        *args, precheck, forced = prepare_batch_r1_split(curve, padded)
+        return (_verify_kernel_r1_split(*args, curve_name=curve.name,
+                                        w=R1_G_WINDOW), precheck, n, forced)
     *args, precheck = prepare_batch_windowed_single(curve, padded,
                                                     R1_G_WINDOW)
     return (_verify_kernel_windowed_single(*args, curve_name=curve.name,
@@ -1323,15 +1595,21 @@ def verify_batch_async_words(curve: WeierstrassCurve, e_words, r_words,
             e_words, r_words, s_words, pub_words, HYBRID_G_WINDOW)
         return (_verify_kernel_hybrid_wide(*args, g_w=HYBRID_G_WINDOW),
                 precheck, n)
-    *args, precheck = _prepare_windowed_single_native_words(
+    *args, precheck, forced = _prepare_r1_split_native_words(
         e_words, r_words, s_words, pub_words, R1_G_WINDOW)
-    return (_verify_kernel_windowed_single(*args, curve_name=curve.name,
-                                           w=R1_G_WINDOW), precheck, n)
+    return (_verify_kernel_r1_split(*args, curve_name=curve.name,
+                                    w=R1_G_WINDOW), precheck, n, forced)
 
 
 def finish_batch(pending) -> np.ndarray:
-    """Force a verify_batch_async dispatch into host verdicts."""
-    dev, precheck, n = pending
+    """Force a verify_batch_async dispatch into host verdicts. Pendings
+    are (dev, precheck, n) or, for the half-gcd split path,
+    (dev, precheck_eff, n, forced) — forced carries the host-oracle
+    verdicts of the per-item fallbacks masked out of precheck_eff."""
+    dev, precheck, n, *rest = pending
     if n == 0:
         return np.zeros(0, dtype=bool)
-    return (np.asarray(dev) & precheck)[:n]
+    ok = np.asarray(dev) & precheck
+    if rest:
+        ok = ok | rest[0]
+    return ok[:n]

@@ -14,7 +14,9 @@ from .sharded import (  # noqa: F401
     sharded_ecdsa_verify_hybrid,
     sharded_merkle_root,
     sharded_verify_batch_ed25519,
+    sharded_ecdsa_verify_r1_split,
     sharded_verify_batch_secp256k1,
     sharded_verify_batch_secp256k1_words,
+    sharded_verify_batch_secp256r1_words,
     tx_verify_step,
 )

@@ -253,6 +253,61 @@ def sharded_verify_batch_secp256k1_words(mesh: Mesh, e_words, r_words,
     return (ok & precheck)[:n]
 
 
+def sharded_ecdsa_verify_r1_split(mesh: Mesh):
+    """Batch-sharded secp256r1 verify over the HALF-GCD split kernel —
+    the fastest single-chip r1 path (ops.weierstrass.verify_core_r1_split),
+    scaled the same dp way: both constant tables (G and [2^128]G)
+    replicated per chip, batch axis sharded.
+
+    Input layout (from ops.weierstrass._prepare_r1_split_native_words):
+    g_idx (128/w, 2, B); q_digits (128/w, w/4, B); Q 2×(B, 16);
+    xd_limbs (B, 16); six replicated table arrays."""
+    core = functools.partial(wc_ops.verify_core_r1_split,
+                             curve_name="secp256r1", w=wc_ops.R1_G_WINDOW)
+    shmapped = jax.shard_map(
+        core, mesh=mesh,
+        in_specs=(P(None, None, AXIS), P(None, None, AXIS),
+                  (P(AXIS, None),) * 2, P(AXIS, None),
+                  P(None, None), P(None, None), P(None),
+                  P(None, None), P(None, None), P(None)),
+        out_specs=P(AXIS),
+        check_vma=False)  # see sharded_ed25519_verify
+    return jax.jit(shmapped)
+
+
+def _r1_mesh_fn(mesh: Mesh, _cache={}):
+    """(jitted split verify fn, replicated G + G' tables) per mesh, built
+    once — the r1 sibling of _k1_mesh_fn (same re-broadcast rationale)."""
+    key = ("secp256r1", id(mesh))
+    if key not in _cache:
+        from ..core.crypto.ecmath import SECP256R1
+        rep = jax.NamedSharding(mesh, P())
+        w = wc_ops.R1_G_WINDOW
+        tabs = tuple(jax.device_put(t, rep) for t in
+                     (*wc_ops._g_window_table_single(SECP256R1, w),
+                      *wc_ops._g_window_table_single(SECP256R1, w, 128)))
+        _cache[key] = (sharded_ecdsa_verify_r1_split(mesh), tabs)
+    return _cache[key]
+
+
+def sharded_verify_batch_secp256r1_words(mesh: Mesh, e_words, r_words,
+                                         s_words, pub_words):
+    """Word-form secp256r1 mesh entry (the batcher's r1 bucket): native
+    half-gcd prep once on host, device verdicts dp-sharded, per-item
+    host-oracle fallbacks OR-ed back in exactly like finish_batch.
+    Requires wc_ops.words_prep_available."""
+    n = len(e_words)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    e_words, r_words, s_words, pub_words = wc_ops.pad_word_rows(
+        (e_words, r_words, s_words, pub_words), _pad_to_mesh_bucket(n, mesh))
+    *args, precheck, forced = wc_ops._prepare_r1_split_native_words(
+        e_words, r_words, s_words, pub_words, wc_ops.R1_G_WINDOW)
+    fn, tabs = _r1_mesh_fn(mesh)
+    ok = np.asarray(fn(*args[:-6], *tabs))
+    return ((ok & precheck) | forced)[:n]
+
+
 def tx_verify_step(mesh: Mesh):
     """The flagship full device step: one batch of transaction work —
     Ed25519 signature checks (dp-sharded) + Merkle component rooting

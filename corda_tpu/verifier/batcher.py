@@ -630,6 +630,14 @@ class SignatureBatcher:
                     self.mesh, *self._ecdsa_words(curve, items))
             return sharded_verify_batch_secp256k1(
                 self.mesh, self._ecdsa_kernel_items(curve, items))
+        if (self.mesh is not None and bucket == "secp256r1"
+                and wc_ops.words_prep_available(curve)):
+            # the half-gcd split kernel's mesh variant (no item-tuple mesh
+            # fallback: without the native prep the single-chip path below
+            # is the same python prep the mesh would run host-side anyway)
+            from ..parallel import sharded_verify_batch_secp256r1_words
+            return sharded_verify_batch_secp256r1_words(
+                self.mesh, *self._ecdsa_words(curve, items))
         return wc_ops.verify_batch(curve, self._ecdsa_kernel_items(curve,
                                                                    items))
 

@@ -23,6 +23,7 @@ def test_bench_smoke_emits_full_json_schema():
             "metric", "value", "unit", "vs_baseline",
             "ed25519_verifies_per_sec_per_chip",
             "secp256r1_verifies_per_sec_per_chip",
+            "r1_halfgcd_fallback_pct", "r1_doublings_per_op",
             "service_path_verifies_per_sec",
             "ed25519_service_path_verifies_per_sec",
             "secp256r1_service_path_verifies_per_sec",

@@ -8,6 +8,7 @@ tampered, and structurally-malformed inputs.  Mirrors the reference's
 approach of differential-testing Crypto.doVerify against test vectors
 (core/src/test/kotlin/net/corda/core/crypto/CryptoUtilsTest.kt).
 """
+import os
 import random
 
 import numpy as np
@@ -260,6 +261,24 @@ def test_pub_row_cache_matches_decompress():
             assert sec1_pub_row_cached(curve, enc) is row   # LRU hit
         assert sec1_pub_row_cached(curve, b"\x02" + b"\xff" * 32) is None
         assert sec1_pub_row_cached(curve, b"\x09" * 33) is None
+
+
+def test_stale_so_falls_back_loudly(caplog):
+    """ABI gate (sm_version): a stale .so must be REFUSED with a warning —
+    the Python fallback is bit-identical (differential tests above), so a
+    silent downgrade would masquerade as a performance regression."""
+    import logging
+    real = next(p for p in sp._CANDIDATES if os.path.exists(p))
+    with caplog.at_level(logging.WARNING, logger="corda_tpu.ops.scalarprep"):
+        assert sp._load(candidates=[real],
+                        expected=sp.SM_VERSION + 1) is None
+    assert any("stale libscalarmath.so" in rec.message
+               and "make -C native libscalarmath.so" in rec.message
+               for rec in caplog.records)
+    # the matching version loads fine (the gate, not the loader, refused)
+    assert sp._load(candidates=[real]) is not None
+    # and a refused library means available() gates every native seam
+    assert sp.SM_VERSION == 3  # bumped 2→3 with sm_r1_halfgcd/sm_r1_prep_hg
 
 
 def test_k1_verify_through_native_prep():
