@@ -31,6 +31,12 @@ Flags:
              fast enough for a tier-1 CPU test (tests/test_bench_smoke.py).
              Kernel-rate fields are emitted as 0.0 and "smoke": true is
              added; every other JSON field keeps its shape.
+  --guard    regression gate (corda_tpu.tools.benchguard): after printing
+             the artifact, check it against floors fit from the repo's
+             BENCH_r*.json trajectory (best-so-far minus a documented
+             tolerance) and exit 1 with a readable diff on a breach. With
+             --smoke the gate degrades to a schema check (zeroed kernel
+             rates carry no information), so `--smoke --guard` is CI-safe.
 """
 from __future__ import annotations
 
@@ -55,6 +61,7 @@ from corda_tpu.ops import ed25519 as ed_ops
 from corda_tpu.ops import weierstrass as wc_ops
 
 SMOKE = "--smoke" in sys.argv
+GUARD = "--guard" in sys.argv
 # smoke: small enough that every per-scheme drain stays below the batcher's
 # host_crossover (192) even when REPS groups coalesce into one flush
 BATCH = int(os.environ.get("CORDA_TPU_BENCH_N", 48 if SMOKE else 32768))
@@ -233,6 +240,9 @@ def service_metrics(k1_items, ed_items, r1_items):
     mixed = (ed_triples[: int(0.45 * n)] + k1_triples[: int(0.45 * n)]
              + r1_full[: max(1, n - 2 * int(0.45 * n))])
     registry = MetricRegistry()
+    # the kernel flight recorder's gauges/histograms ride the same snapshot
+    from corda_tpu.observability import get_profiler
+    get_profiler().publish(registry)
     batcher = SignatureBatcher(metrics=registry)
     try:
         k1_rate = _service_rate_for(batcher, k1_triples)
@@ -272,7 +282,11 @@ def service_metrics(k1_items, ed_items, r1_items):
 
 
 def main() -> None:
+    from corda_tpu.observability import get_profiler
     from corda_tpu.verifier.batcher import SignatureBatcher
+    # fresh flight-recorder counters: this run's compiles/occupancy/overlap
+    # only (the profiler is process-global and always on)
+    get_profiler().reset()
     items = make_items(BATCH)
     ed_items = make_ed_items(BATCH)
     r1_items = make_items(BATCH, ecmath.SECP256R1)
@@ -309,9 +323,29 @@ def main() -> None:
         "prep_overlap_max": overlap,
         **stages,
     }
+    # flight-recorder fields (corda_tpu.observability.profiling): where the
+    # wall time went — XLA compiles vs cached dispatches, how full the
+    # padded device batches ran, and how much host prep overlapped device
+    # work. benchguard schema-locks these; the values are diagnostics.
+    prof = get_profiler()
+    totals = prof.compile_totals()
+    out["compile_s_total"] = round(totals["compile_s_total"], 3)
+    out["compile_cache_hits"] = totals["compile_cache_hits"]
+    out["occupancy_pct_per_scheme"] = prof.occupancy_pct_per_scheme()
+    out["prep_overlap_pct"] = round(prof.overlap.snapshot()["overlap_pct"], 2)
     if SMOKE:
         out["smoke"] = True
     print(json.dumps(out))
+    if GUARD:
+        from corda_tpu.tools.benchguard import guard_current
+        problems = guard_current(out)
+        if problems:
+            print("BENCH REGRESSION: guarded metrics breached their "
+                  "trajectory floors:", file=sys.stderr)
+            for p in problems:
+                print(f"  {p}", file=sys.stderr)
+            sys.exit(1)
+        print("benchguard: ok", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -254,8 +254,10 @@ class RaftNode:
         pending-request table cannot leak. ``trace_ctx`` parents a
         "raft.submit" span covering submission → commit/apply (finished when
         the response resolves the future)."""
-        from ..observability import get_tracer
+        from ..observability import get_tracer, jlog
         tracer = get_tracer()
+        jlog(log, "raft.submit", ctx=trace_ctx, node=self.node_id,
+             role=self.role)
         with self._lock:
             fut: Future = Future()
             rid = next(self._request_ids)

@@ -8,7 +8,11 @@ the node's SerialExecutor (the single node-thread discipline,
 AffinityExecutor parity) so the state machine never sees concurrent calls.
 
 Wire frame: 4-byte big-endian length + canonical-codec bytes of
-[topic, session_id, sender_name, payload]. Undeliverable messages are parked
+[topic, session_id, sender_name, payload] with an OPTIONAL fifth element
+[trace_id, span_id] when the sender propagates a trace context
+(observability.tracing) — absent on untraced sends, and old four-element
+frames still decode, so mixed-version planes interoperate. Undeliverable
+messages are parked
 and replayed on handler registration (NodeMessagingClient retention), and
 sends to unreachable peers are retried with a delay
 (messageRedeliveryDelaySeconds analog).
@@ -56,6 +60,8 @@ class TcpMessagingService(MessagingService):
     (fed by the network map cache). All sends/receives run on a private
     asyncio loop thread; inbound handler callbacks run on ``executor``.
     """
+
+    supports_trace = True
 
     def __init__(self, my_name: str, host: str, port: int,
                  resolve_address: Callable[[str], tuple | None],
@@ -137,10 +143,11 @@ class TcpMessagingService(MessagingService):
                     raise MessageSizeExceededError(
                         f"inbound frame too large: {length}")
                 body = await reader.readexactly(length)
-                topic, session_id, sender, payload = deserialize(body)
+                topic, session_id, sender, payload, *rest = deserialize(body)
+                trace = tuple(rest[0]) if rest and rest[0] else None
                 msg = Message(TopicSession(topic, session_id), payload,
                               sender=cert_cn if cert_cn is not None
-                              else sender)
+                              else sender, trace=trace)
                 self.executor.execute(lambda m=msg: self._deliver(m))
         except (asyncio.IncompleteReadError, ConnectionResetError,
                 MessageSizeExceededError):
@@ -167,9 +174,12 @@ class TcpMessagingService(MessagingService):
         return self._name
 
     def send(self, topic_session: TopicSession, payload: bytes,
-             recipient: str) -> None:
-        frame_body = serialize([topic_session.topic, topic_session.session_id,
-                                self._name, payload])
+             recipient: str, trace: tuple | None = None) -> None:
+        body = [topic_session.topic, topic_session.session_id,
+                self._name, payload]
+        if trace is not None:
+            body.append(list(trace))
+        frame_body = serialize(body)
         if len(frame_body) > self.max_frame:
             # fail the producer synchronously with a typed error: a peer
             # would just sever the connection on the oversized header

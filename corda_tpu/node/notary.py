@@ -13,6 +13,7 @@ interface (corda_tpu.consensus, SURVEY.md §7 phase 5).
 from __future__ import annotations
 
 import datetime
+import logging
 import os
 import threading
 from dataclasses import dataclass
@@ -20,6 +21,8 @@ from dataclasses import dataclass
 from ..core.contracts.structures import StateRef
 from ..core.identity import Party
 from ..core.serialization import deserialize, register_type, serialize
+
+_log = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -143,6 +146,10 @@ class NotaryService:
     def __init__(self, hub, uniqueness: UniquenessProvider | None = None,
                  time_window_checker: TimeWindowChecker | None = None):
         self.hub = hub
+        # back-reference for the node's readiness probe (/readyz checks the
+        # commit-log backend — e.g. a raft cluster without a leader is not
+        # ready to notarise)
+        hub.notary_service = self
         self.uniqueness = uniqueness if uniqueness is not None \
             else InMemoryUniquenessProvider()
         self.time_window_checker = time_window_checker or TimeWindowChecker()
@@ -160,8 +167,11 @@ class NotaryService:
 
     def commit(self, input_refs, tx_id, caller_name: str,
                trace_ctx=None) -> None:
-        from ..observability import get_tracer
+        from ..observability import get_tracer, jlog
         refs = list(input_refs)
+        jlog(_log, "notary.commit", ctx=trace_ctx,
+             tx_id=tx_id.bytes.hex()[:16], n_inputs=len(refs),
+             caller=caller_name)
         with get_tracer().span("notary.commit", parent=trace_ctx,
                                tx_id=tx_id.bytes.hex()[:16],
                                n_inputs=len(refs), caller=caller_name):

@@ -210,6 +210,41 @@ class CordaRPCOps:
         timers/meters, batcher counters, flow rates)."""
         return self.hub.monitoring.snapshot()
 
+    def health(self) -> dict:
+        """Readiness payload for /readyz: named pass/fail checks plus the
+        ``ready`` conjunction. Checks apply only where the capability
+        exists — a host-only node is not held unready for cold device
+        tables, a non-notary node not for raft state."""
+        checks: dict = {}
+        svc = self.hub.verifier_service
+        batcher = getattr(svc, "batcher", None)
+        if batcher is not None:
+            # the dispatcher thread is the batcher's heart: if it died (or
+            # close() ran), every queued Future would hang forever
+            checks["batcher_dispatcher_alive"] = (
+                batcher._thread.is_alive() and not batcher._closed)
+            if batcher.use_device:
+                # first-verify latency pays the multi-MB table transfer
+                # unless the committed-table cache is already warm
+                from ..ops.field import _DEVICE_TABLE_CACHE
+                checks["device_tables_warm"] = bool(_DEVICE_TABLE_CACHE)
+        notary = getattr(self.hub, "notary_service", None)
+        if notary is not None:
+            raft = getattr(notary.uniqueness, "raft", None)
+            if raft is not None:
+                checks["raft_leader_known"] = raft.leader_id is not None
+        else:
+            # non-notary node: ready means it can REACH a notary
+            checks["notary_known"] = bool(self.notary_identities())
+        return {"ready": all(checks.values()), "checks": checks}
+
+    def profile_snapshot(self) -> dict:
+        """The kernel flight recorder's full state (/debug/profile):
+        per-kernel compile/dispatch/wait accounting, batch occupancy,
+        prep/device overlap."""
+        from ..observability import get_profiler
+        return get_profiler().snapshot()
+
     def vault_feed(self, state_type: type | None = None) -> DataFeed:
         def subscribe(cb):
             self.hub.vault.add_update_observer(cb)

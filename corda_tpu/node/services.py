@@ -286,12 +286,31 @@ class ServiceHub:
 
     def __init__(self, my_info: NodeInfo, network_service,
                  key_pairs=(), verifier_service=None):
+        from ..observability import get_profiler, get_tracer
         from ..utils.metrics import MetricRegistry
         self.my_info = my_info
         self.network_service = network_service
         # the node-wide metric registry (MonitoringService.kt:11 parity);
         # the verifier service and SMM publish into it, /metrics exports it
         self.monitoring = MetricRegistry()
+        # span-ring accounting: how many spans the bounded ring has evicted
+        # (a scraper seeing this grow knows /traces is lossy right now) and
+        # how many it holds. Read through get_tracer per call so
+        # enable/disable_tracing swaps take effect; the no-op tracer has no
+        # ring → both read 0.
+        self.monitoring.gauge(
+            "Tracing.SpansDropped",
+            lambda: getattr(getattr(get_tracer(), "ring", None),
+                            "dropped", 0) or 0)
+        self.monitoring.gauge(
+            "Tracing.SpansBuffered",
+            lambda: len(getattr(get_tracer(), "ring", None) or ()))
+        # kernel flight recorder (observability/profiling): compile/
+        # occupancy/overlap gauges + the shared dispatch histograms
+        get_profiler().publish(self.monitoring)
+        # set by NotaryService.__init__ on notary nodes; the readiness
+        # probe checks its commit-log backend
+        self.notary_service = None
         from .audit import InMemoryAuditService
         self.audit = InMemoryAuditService()
         self.storage = TransactionStorage()

@@ -15,6 +15,7 @@ AbstractNode serverThread — and exactly MockNetwork's deterministic pumping).
 """
 from __future__ import annotations
 
+import logging
 import queue
 import time as _time
 import uuid
@@ -29,8 +30,10 @@ from ..flows.api import (ExecuteOnce, FlowException, FlowLogic, FlowSession,
                          WaitForLedgerCommit, flow_name,
                          get_initiated_flow_factory)
 from ..network.messaging import TOPIC_P2P, TopicSession
-from ..observability import get_tracer
+from ..observability import get_tracer, jlog
 from .checkpoints import Checkpoint, CheckpointStorage, SessionSnapshot
+
+_log = logging.getLogger(__name__)
 
 
 # ---------------------------------------------------------------------------
@@ -243,6 +246,8 @@ class StateMachineManager:
                 "flow.run", parent=fsm.trace_ctx,
                 flow_type=flow_name(type(fsm.flow)), flow_id=fsm.run_id)
             fsm.trace_ctx = fsm.trace_span.context()
+        jlog(_log, "flow.start", ctx=fsm.trace_ctx,
+             flow_type=flow_name(type(fsm.flow)), flow_id=fsm.run_id)
         self.flows[fsm.run_id] = fsm
         fsm.flow.state_machine = fsm
         fsm.flow.service_hub = self.hub
@@ -760,6 +765,8 @@ class StateMachineManager:
         if fsm.trace_span is not None:
             fsm.trace_span.finish()
             fsm.trace_span = None
+        jlog(_log, "flow.end", ctx=fsm.trace_ctx,
+             flow_type=flow_name(type(fsm.flow)), flow_id=fsm.run_id)
         monitoring = getattr(self.hub, "monitoring", None)
         if monitoring is not None and fsm.run_id in self.flows:
             monitoring.meter("Flows.Finished").mark()

@@ -10,18 +10,26 @@ Three pieces (docs/OBSERVABILITY.md):
   JSONL export and the /traces endpoint's query surface.
 - stages.py — per-stage (prep/dispatch/finish) percentile flattening for
   bench.py's JSON artifact.
+- profiling.py — the kernel flight recorder: compile-cache accounting,
+  device dispatch/wait wall time, batch occupancy, prep/device overlap;
+  always-on, exported through /metrics and /debug/profile.
+- slog.py — structured JSON log lines correlated by trace_id.
 
 The Histogram metric type itself lives in utils/metrics.py with the rest
 of the registry.
 """
+from .profiling import (KernelProfiler, OverlapTracker, get_profiler,
+                        set_profiler)
 from .ring import SpanRing
+from .slog import jlog
 from .stages import STAGE_METRICS, stage_percentiles
 from .tracing import (NOOP_SPAN, NOOP_TRACER, NoopTracer, Span, SpanContext,
                       Tracer, disable_tracing, enable_tracing, get_tracer,
                       set_tracer)
 
 __all__ = [
-    "NOOP_SPAN", "NOOP_TRACER", "NoopTracer", "Span", "SpanContext",
-    "SpanRing", "STAGE_METRICS", "Tracer", "disable_tracing",
-    "enable_tracing", "get_tracer", "set_tracer", "stage_percentiles",
+    "KernelProfiler", "NOOP_SPAN", "NOOP_TRACER", "NoopTracer",
+    "OverlapTracker", "Span", "SpanContext", "SpanRing", "STAGE_METRICS",
+    "Tracer", "disable_tracing", "enable_tracing", "get_profiler",
+    "get_tracer", "jlog", "set_profiler", "set_tracer", "stage_percentiles",
 ]

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import time as _time
 
 import jax
 import jax.numpy as jnp
@@ -663,18 +664,28 @@ def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
     """Dispatch without forcing (see weierstrass.verify_batch_async): the
     device computes while the caller preps the next batch. Rides the
     split-k half-length ladder — the fastest measured path (BASELINE.md
-    round 5)."""
+    round 5). Dispatches go through the kernel flight recorder
+    (observability.profiling): compile-cache accounting + batch occupancy."""
+    from ..observability.profiling import get_profiler
     n = len(items)
     if n == 0:
         return (None, np.zeros(0, dtype=bool), 0)
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
     *args, precheck = prepare_batch_split(padded, SPLIT_B_WINDOW)
-    return (_verify_kernel_split(*args, w=SPLIT_B_WINDOW), precheck, n)
+    dev = get_profiler().call("ed25519.split", _verify_kernel_split, *args,
+                              w=SPLIT_B_WINDOW, live=n,
+                              capacity=len(padded), scheme="ed25519")
+    return (dev, precheck, n)
 
 
 def finish_batch(pending) -> np.ndarray:
+    from ..observability.profiling import get_profiler
     dev, precheck, n = pending
     if n == 0:
         return np.zeros(0, dtype=bool)
+    prof = get_profiler()
+    name = prof.pending_name(dev, "ed25519.split")
+    t0 = _time.perf_counter()
     ok = np.asarray(dev)
+    prof.device_wait(name, _time.perf_counter() - t0)
     return (ok & precheck)[:n]

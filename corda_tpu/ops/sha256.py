@@ -102,8 +102,9 @@ def _sha256_blocks_impl(blocks):
 def sha256_blocks(blocks) -> jax.Array:
     """Hash a batch of pre-padded messages: ``blocks`` (..., n_blocks, 16) u32
     big-endian words → digests (..., 8) u32."""
+    from ..observability.profiling import get_profiler
     blocks = jnp.asarray(blocks, dtype=jnp.uint32)
-    return _sha256_blocks_impl(blocks)
+    return get_profiler().call("sha256.blocks", _sha256_blocks_impl, blocks)
 
 
 @jax.jit
@@ -142,7 +143,9 @@ def merkle_root(leaves) -> jax.Array:
         raise ValueError("merkle_root requires a power-of-two leaf count (zero-pad)")
     if n == 1:
         return leaves[..., 0, :]
-    return _merkle_root_impl(leaves)
+    from ..observability.profiling import get_profiler
+    return get_profiler().call("sha256.merkle_root", _merkle_root_impl,
+                               leaves)
 
 
 # ---------------------------------------------------------------------------

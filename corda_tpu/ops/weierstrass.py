@@ -25,6 +25,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -1502,20 +1503,28 @@ def verify_batch(curve: WeierstrassCurve,
         raise ValueError(f"mode {mode!r} requires secp256k1")
     if mode == "halfgcd" and curve.name != "secp256r1":
         raise ValueError(f"mode {mode!r} requires secp256r1")
+    from ..observability.profiling import get_profiler
+    prof = get_profiler()
     if mode == "halfgcd":
         *args, precheck, forced = prepare_batch_r1_split(curve, padded)
-        ok = np.asarray(_verify_kernel_r1_split(
-            *args, curve_name=curve.name, w=R1_G_WINDOW))
+        ok = np.asarray(prof.call(
+            "weierstrass.r1_split", _verify_kernel_r1_split, *args,
+            curve_name=curve.name, w=R1_G_WINDOW,
+            live=n, capacity=len(padded), scheme=curve.name))
         return ((ok & precheck) | forced)[:n]
     if mode == "hybrid":
         *args, precheck = prepare_batch_hybrid_wide(padded, HYBRID_G_WINDOW)
-        ok = np.asarray(_verify_kernel_hybrid_wide(*args,
-                                                   g_w=HYBRID_G_WINDOW))
+        ok = np.asarray(prof.call(
+            "weierstrass.hybrid_k1", _verify_kernel_hybrid_wide, *args,
+            g_w=HYBRID_G_WINDOW,
+            live=n, capacity=len(padded), scheme=curve.name))
     elif mode == "windowed":
         *args, precheck = prepare_batch_windowed_single(curve, padded,
                                                         R1_G_WINDOW)
-        ok = np.asarray(_verify_kernel_windowed_single(
-            *args, curve_name=curve.name, w=R1_G_WINDOW))
+        ok = np.asarray(prof.call(
+            "weierstrass.windowed", _verify_kernel_windowed_single, *args,
+            curve_name=curve.name, w=R1_G_WINDOW,
+            live=n, capacity=len(padded), scheme=curve.name))
     elif mode == "glv":
         bits4, pts4, r_cands, precheck = prepare_batch_glv(padded)
         ok = np.asarray(_verify_kernel_glv(bits4, pts4, r_cands))
@@ -1532,22 +1541,30 @@ def verify_batch_async(curve: WeierstrassCurve,
     pending handle for :func:`finish_batch`. The device computes while the
     caller preps the next batch (the service batcher's one-deep pipeline —
     host prep was ~2/3 of the unpipelined service-path cost)."""
+    from ..observability.profiling import get_profiler
+    prof = get_profiler()
     n = len(items)
     if n == 0:
         return (None, np.zeros(0, dtype=bool), 0)
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
     if curve.name == "secp256k1":
         *args, precheck = prepare_batch_hybrid_wide(padded, HYBRID_G_WINDOW)
-        return (_verify_kernel_hybrid_wide(*args, g_w=HYBRID_G_WINDOW),
+        return (prof.call("weierstrass.hybrid_k1", _verify_kernel_hybrid_wide,
+                          *args, g_w=HYBRID_G_WINDOW, live=n,
+                          capacity=len(padded), scheme=curve.name),
                 precheck, n)
     if curve.name == "secp256r1":
         *args, precheck, forced = prepare_batch_r1_split(curve, padded)
-        return (_verify_kernel_r1_split(*args, curve_name=curve.name,
-                                        w=R1_G_WINDOW), precheck, n, forced)
+        return (prof.call("weierstrass.r1_split", _verify_kernel_r1_split,
+                          *args, curve_name=curve.name, w=R1_G_WINDOW,
+                          live=n, capacity=len(padded), scheme=curve.name),
+                precheck, n, forced)
     *args, precheck = prepare_batch_windowed_single(curve, padded,
                                                     R1_G_WINDOW)
-    return (_verify_kernel_windowed_single(*args, curve_name=curve.name,
-                                           w=R1_G_WINDOW), precheck, n)
+    return (prof.call("weierstrass.windowed", _verify_kernel_windowed_single,
+                      *args, curve_name=curve.name, w=R1_G_WINDOW,
+                      live=n, capacity=len(padded), scheme=curve.name),
+            precheck, n)
 
 
 def words_prep_available(curve: WeierstrassCurve) -> bool:
@@ -1585,31 +1602,46 @@ def verify_batch_async_words(curve: WeierstrassCurve, e_words, r_words,
     parse, e from digests_to_words), skipping the per-item decompress +
     DER + to_bytes loop entirely. Same pending/finish contract as
     :func:`verify_batch_async`; callers gate on words_prep_available."""
+    from ..observability.profiling import get_profiler
+    prof = get_profiler()
     n = len(e_words)
     if n == 0:
         return (None, np.zeros(0, dtype=bool), 0)
+    capacity = F.bucket_size(n)
     e_words, r_words, s_words, pub_words = pad_word_rows(
-        (e_words, r_words, s_words, pub_words), F.bucket_size(n))
+        (e_words, r_words, s_words, pub_words), capacity)
     if curve.name == "secp256k1":
         *args, precheck = _prepare_hybrid_native_words(
             e_words, r_words, s_words, pub_words, HYBRID_G_WINDOW)
-        return (_verify_kernel_hybrid_wide(*args, g_w=HYBRID_G_WINDOW),
+        return (prof.call("weierstrass.hybrid_k1", _verify_kernel_hybrid_wide,
+                          *args, g_w=HYBRID_G_WINDOW, live=n,
+                          capacity=capacity, scheme=curve.name),
                 precheck, n)
     *args, precheck, forced = _prepare_r1_split_native_words(
         e_words, r_words, s_words, pub_words, R1_G_WINDOW)
-    return (_verify_kernel_r1_split(*args, curve_name=curve.name,
-                                    w=R1_G_WINDOW), precheck, n, forced)
+    return (prof.call("weierstrass.r1_split", _verify_kernel_r1_split,
+                      *args, curve_name=curve.name, w=R1_G_WINDOW,
+                      live=n, capacity=capacity, scheme=curve.name),
+            precheck, n, forced)
 
 
 def finish_batch(pending) -> np.ndarray:
     """Force a verify_batch_async dispatch into host verdicts. Pendings
     are (dev, precheck, n) or, for the half-gcd split path,
     (dev, precheck_eff, n, forced) — forced carries the host-oracle
-    verdicts of the per-item fallbacks masked out of precheck_eff."""
+    verdicts of the per-item fallbacks masked out of precheck_eff.
+    The force wall time lands in the flight recorder as device wait,
+    attributed to the dispatching kernel via the pending handle."""
+    from ..observability.profiling import get_profiler
     dev, precheck, n, *rest = pending
     if n == 0:
         return np.zeros(0, dtype=bool)
-    ok = np.asarray(dev) & precheck
+    prof = get_profiler()
+    name = prof.pending_name(dev, "weierstrass")
+    t0 = time.perf_counter()
+    forced_dev = np.asarray(dev)
+    prof.device_wait(name, time.perf_counter() - t0)
+    ok = forced_dev & precheck
     if rest:
         ok = ok | rest[0]
     return ok[:n]

@@ -178,6 +178,23 @@ def _pad_to_mesh_bucket(n: int, mesh: Mesh) -> int:
     return F.bucket_size(-(-n // d)) * d
 
 
+def _profiler():
+    from ..observability.profiling import get_profiler
+    return get_profiler()
+
+
+def _forced(dev) -> np.ndarray:
+    """Force a sharded dispatch to host, booking the wait in the flight
+    recorder against the kernel prof.call just attributed to ``dev``."""
+    import time
+    prof = _profiler()
+    name = prof.pending_name(dev, "sharded")
+    t0 = time.perf_counter()
+    out = np.asarray(dev)
+    prof.device_wait(name, time.perf_counter() - t0)
+    return out
+
+
 def sharded_verify_batch_ed25519(mesh: Mesh, items, _cache={}):
     """[(pub32, sig64, msg)] → bool verdicts (B,), the batch dp-sharded over
     ``mesh`` — the drop-in mesh backend for the SignatureBatcher
@@ -199,7 +216,9 @@ def sharded_verify_batch_ed25519(mesh: Mesh, items, _cache={}):
                                *ed_ops._b_window_table(w, 128)))
         _cache[key] = (sharded_ed25519_verify_split(mesh), tabs)
     fn, tabs = _cache[key]
-    ok = np.asarray(fn(*args, *tabs))
+    ok = _forced(_profiler().call("sharded.ed25519", fn, *args, *tabs,
+                                  live=n, capacity=len(padded),
+                                  scheme="ed25519"))
     return (ok & precheck)[:n]
 
 
@@ -231,7 +250,9 @@ def sharded_verify_batch_secp256k1(mesh: Mesh, items):
     *args, precheck = \
         wc_ops.prepare_batch_hybrid_wide(padded, wc_ops.HYBRID_G_WINDOW)
     fn, tabs = _k1_mesh_fn(mesh)
-    ok = np.asarray(fn(*args[:-3], *tabs))
+    ok = _forced(_profiler().call("sharded.hybrid_k1", fn, *args[:-3], *tabs,
+                                  live=n, capacity=len(padded),
+                                  scheme="secp256k1"))
     return (ok & precheck)[:n]
 
 
@@ -244,12 +265,15 @@ def sharded_verify_batch_secp256k1_words(mesh: Mesh, e_words, r_words,
     n = len(e_words)
     if n == 0:
         return np.zeros(0, dtype=bool)
+    capacity = _pad_to_mesh_bucket(n, mesh)
     e_words, r_words, s_words, pub_words = wc_ops.pad_word_rows(
-        (e_words, r_words, s_words, pub_words), _pad_to_mesh_bucket(n, mesh))
+        (e_words, r_words, s_words, pub_words), capacity)
     *args, precheck = wc_ops._prepare_hybrid_native_words(
         e_words, r_words, s_words, pub_words, wc_ops.HYBRID_G_WINDOW)
     fn, tabs = _k1_mesh_fn(mesh)
-    ok = np.asarray(fn(*args[:-3], *tabs))
+    ok = _forced(_profiler().call("sharded.hybrid_k1", fn, *args[:-3], *tabs,
+                                  live=n, capacity=capacity,
+                                  scheme="secp256k1"))
     return (ok & precheck)[:n]
 
 
@@ -299,12 +323,15 @@ def sharded_verify_batch_secp256r1_words(mesh: Mesh, e_words, r_words,
     n = len(e_words)
     if n == 0:
         return np.zeros(0, dtype=bool)
+    capacity = _pad_to_mesh_bucket(n, mesh)
     e_words, r_words, s_words, pub_words = wc_ops.pad_word_rows(
-        (e_words, r_words, s_words, pub_words), _pad_to_mesh_bucket(n, mesh))
+        (e_words, r_words, s_words, pub_words), capacity)
     *args, precheck, forced = wc_ops._prepare_r1_split_native_words(
         e_words, r_words, s_words, pub_words, wc_ops.R1_G_WINDOW)
     fn, tabs = _r1_mesh_fn(mesh)
-    ok = np.asarray(fn(*args[:-6], *tabs))
+    ok = _forced(_profiler().call("sharded.r1_split", fn, *args[:-6], *tabs,
+                                  live=n, capacity=capacity,
+                                  scheme="secp256r1"))
     return ((ok & precheck) | forced)[:n]
 
 

@@ -1,0 +1,216 @@
+"""Bench regression gate: fit floors from the BENCH_r*.json trajectory.
+
+The driver keeps one benchmark artifact per round (BENCH_r01.json …); each
+is the JSON line bench.py printed (either raw, or wrapped under a
+``parsed`` key by the harness). This module turns that trajectory into
+per-metric *guards*: for every tracked metric the best value seen so far,
+minus a documented tolerance, becomes the floor (rates) or ceiling
+(latencies) the next run must clear. ``bench.py --guard`` runs the gate
+in-process after measuring; the CLI replays it over saved artifacts.
+
+Tolerances are calibrated against the real trajectory's noise, not pulled
+from the air:
+
+- ``RATE_TOLERANCE`` (15%): vs_baseline dipped 17.811 → 15.831 between
+  r02 and r03 (the host OpenSSL baseline sped up, not a device
+  regression) — an 11.1% swing, so the rate guard must absorb ~15%.
+- ``LATENCY_TOLERANCE`` (35%): tx_verify_p50_ms_batch1 rose 0.573 →
+  0.719 between r03 and r05 (+25.5%) while every throughput metric
+  improved — single-item p50 through a live batcher is linger-window
+  noise, so the latency guard must absorb ~35%.
+
+A *smoke* artifact (bench.py --smoke, ``"smoke": true``) carries zeroed
+kernel rates from a tiny CPU run: comparing its values would be
+meaningless, so the gate degrades to a schema check — every field the
+trajectory tracks must at least EXIST with the right shape. That is what
+lets `bench.py --smoke --guard` gate wiring regressions in tier-1 CI
+without a device.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import sys
+
+#: Best-so-far slack for higher-is-better rates (see module docstring).
+RATE_TOLERANCE = 0.15
+#: Best-so-far slack for lower-is-better latencies (see module docstring).
+LATENCY_TOLERANCE = 0.35
+
+#: metric name -> ("higher"|"lower", tolerance). "higher" guards a floor of
+#: best*(1-tol); "lower" a ceiling of best*(1+tol). host_baseline and the
+#: occupancy/overlap/compile diagnostics are observability fields, not
+#: performance promises — they are schema-checked but not value-guarded.
+GUARDED_METRICS: dict = {
+    "value": ("higher", RATE_TOLERANCE),
+    "vs_baseline": ("higher", RATE_TOLERANCE),
+    "ed25519_verifies_per_sec_per_chip": ("higher", RATE_TOLERANCE),
+    "secp256r1_verifies_per_sec_per_chip": ("higher", RATE_TOLERANCE),
+    "service_path_verifies_per_sec": ("higher", RATE_TOLERANCE),
+    "ed25519_service_path_verifies_per_sec": ("higher", RATE_TOLERANCE),
+    "secp256r1_service_path_verifies_per_sec": ("higher", RATE_TOLERANCE),
+    "mixed_service_path_verifies_per_sec": ("higher", RATE_TOLERANCE),
+    "tx_verify_p50_ms_batch1": ("lower", LATENCY_TOLERANCE),
+    "tx_verify_p50_ms_batch1k": ("lower", LATENCY_TOLERANCE),
+}
+
+#: Fields every artifact must carry (the --smoke schema check; value types
+#: are checked when present). The four flight-recorder fields are listed so
+#: a wiring regression that silently drops them fails the smoke gate.
+REQUIRED_FIELDS: tuple = (
+    "metric", "value", "unit", "vs_baseline",
+    "service_path_verifies_per_sec", "tx_verify_p50_ms_batch1",
+    "tx_verify_p50_ms_batch1k",
+    "compile_s_total", "compile_cache_hits",
+    "occupancy_pct_per_scheme", "prep_overlap_pct",
+)
+
+
+def parse_artifact(obj: dict) -> dict:
+    """Accept a raw bench.py JSON line or the harness's ``parsed`` wrapper."""
+    if "parsed" in obj and isinstance(obj["parsed"], dict):
+        return obj["parsed"]
+    return obj
+
+
+def load_trajectory(paths: list[str]) -> list[dict]:
+    """Load + parse the artifacts oldest-first (the paths sort by round)."""
+    runs = []
+    for path in sorted(paths):
+        with open(path, encoding="utf-8") as f:
+            runs.append(parse_artifact(json.load(f)))
+    return runs
+
+
+def fit_guards(trajectory: list[dict]) -> dict:
+    """Per-metric guard from best-so-far across the trajectory (smoke and
+    zero-valued entries are skipped — an absent device run must not drag a
+    floor to 0): {metric: {best, bound, direction, tolerance}}."""
+    guards: dict = {}
+    for run in trajectory:
+        if run.get("smoke"):
+            continue
+        for name, (direction, tol) in GUARDED_METRICS.items():
+            v = run.get(name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+                continue
+            g = guards.get(name)
+            best = v if g is None else (
+                max(g["best"], v) if direction == "higher"
+                else min(g["best"], v))
+            guards[name] = {
+                "best": best,
+                "bound": best * (1 - tol) if direction == "higher"
+                         else best * (1 + tol),
+                "direction": direction,
+                "tolerance": tol,
+            }
+    return guards
+
+
+def schema_violations(current: dict) -> list[str]:
+    """Missing/odd-shaped required fields (the smoke gate's whole check)."""
+    problems = []
+    for name in REQUIRED_FIELDS:
+        if name not in current:
+            problems.append(f"missing required field {name!r}")
+        elif name == "occupancy_pct_per_scheme":
+            if not isinstance(current[name], dict):
+                problems.append(f"{name} should be a dict, got "
+                                f"{type(current[name]).__name__}")
+        elif name in ("metric", "unit"):
+            if not isinstance(current[name], str):
+                problems.append(f"{name} should be a string, got "
+                                f"{type(current[name]).__name__}")
+        elif (isinstance(current[name], bool)
+              or not isinstance(current[name], (int, float))):
+            problems.append(f"{name} should be a number, got "
+                            f"{type(current[name]).__name__}")
+    return problems
+
+
+def check(current: dict, guards: dict) -> list[str]:
+    """Human-readable violation lines (empty = the run passes). A smoke
+    artifact gets the schema check only; a full artifact gets both."""
+    current = parse_artifact(current)
+    problems = schema_violations(current)
+    if current.get("smoke"):
+        return problems
+    for name, g in sorted(guards.items()):
+        v = current.get(name)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue  # absence is the schema check's business
+        if g["direction"] == "higher" and v < g["bound"]:
+            problems.append(
+                f"{name}: {v:g} < floor {g['bound']:.4g} "
+                f"(best {g['best']:g} - {g['tolerance']:.0%} tolerance; "
+                f"higher is better)")
+        elif g["direction"] == "lower" and v > g["bound"]:
+            problems.append(
+                f"{name}: {v:g} > ceiling {g['bound']:.4g} "
+                f"(best {g['best']:g} + {g['tolerance']:.0%} tolerance; "
+                f"lower is better)")
+    return problems
+
+
+def default_trajectory_paths(root: str | None = None) -> list[str]:
+    root = root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return sorted(_glob.glob(os.path.join(root, "BENCH_r*.json")))
+
+
+def guard_current(current: dict, trajectory_paths: list[str] | None = None
+                  ) -> list[str]:
+    """The bench.py --guard entry: fit guards from the repo trajectory and
+    check ``current`` against them. No trajectory → schema check only."""
+    paths = (default_trajectory_paths() if trajectory_paths is None
+             else trajectory_paths)
+    guards = fit_guards(load_trajectory(paths)) if paths else {}
+    return check(current, guards)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI: ``python -m corda_tpu.tools.benchguard [current.json ...]``.
+
+    With no arguments, replays the gate across the repo trajectory itself
+    (each round checked against guards fit from the rounds before it) — the
+    self-test that the tolerances absorb the real noise. With arguments,
+    each file is checked against the full trajectory's guards."""
+    argv = sys.argv[1:] if argv is None else argv
+    paths = default_trajectory_paths()
+    trajectory = load_trajectory(paths)
+    if argv:
+        guards = fit_guards(trajectory)
+        failed = False
+        for path in argv:
+            with open(path, encoding="utf-8") as f:
+                current = parse_artifact(json.load(f))
+            problems = check(current, guards)
+            if problems:
+                failed = True
+                print(f"BENCH REGRESSION in {path}:", file=sys.stderr)
+                for p in problems:
+                    print(f"  {p}", file=sys.stderr)
+            else:
+                print(f"{path}: ok")
+        return 1 if failed else 0
+    # self-replay: round i vs guards from rounds < i (skip schema on the
+    # historical artifacts — early rounds predate today's field set)
+    failed = False
+    for i, run in enumerate(trajectory):
+        guards = fit_guards(trajectory[:i])
+        problems = [p for p in check(run, guards) if "<" in p or ">" in p]
+        label = os.path.basename(paths[i])
+        if problems:
+            failed = True
+            print(f"BENCH REGRESSION at {label}:", file=sys.stderr)
+            for p in problems:
+                print(f"  {p}", file=sys.stderr)
+        else:
+            print(f"{label}: ok")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

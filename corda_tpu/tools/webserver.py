@@ -12,6 +12,10 @@ app APIs and static content. Endpoints:
     GET  /api/flows             registered startable flows
     GET  /api/metrics           metric registry snapshot (JSON)
     GET  /metrics               same, Prometheus text exposition format
+    GET  /healthz               liveness (200 when the server answers)
+    GET  /readyz                readiness checks (200 ready / 503 not)
+    GET  /debug/profile         kernel flight-recorder snapshot
+    GET  /traces                span ring (tracing enabled: spans by trace)
     POST /api/flows/<FlowName>  body: JSON list of args -> run id / result
     GET  /web/<app>/<path>      static app content (staticServeDirs role)
 
@@ -28,16 +32,101 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping: backslash, double quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _family(lines: list, name: str, mtype: str, help_text: str,
+            samples: list) -> None:
+    """Append one metric family: HELP + TYPE headers then its samples.
+    Each sample is ``(suffix, labels_or_None, value, exemplar_or_None)``."""
+    lines.append(f"# HELP {name} {_escape_help(help_text)}")
+    lines.append(f"# TYPE {name} {mtype}")
+    for suffix, labels, value, exemplar in samples:
+        label_s = "" if not labels else "{" + ",".join(
+            f'{k}="{_escape_label(v)}"' for k, v in labels) + "}"
+        line = f"{name}{suffix}{label_s} {value}"
+        if exemplar is not None:
+            # OpenMetrics exemplar: links this bucket to a span in /traces
+            tid = _escape_label(exemplar["trace_id"])
+            line += (f' # {{trace_id="{tid}"}} '
+                     f'{exemplar["value"]} {exemplar["ts"]:.3f}')
+        lines.append(line)
+
+
 def prometheus_text(snapshot: dict) -> str:
-    """Metric snapshot → Prometheus text exposition (one gauge per numeric
-    field, metric names sanitized and prefixed corda_tpu_)."""
-    lines = []
+    """Metric snapshot → Prometheus text exposition.
+
+    Type-aware via the snapshot's ``type`` discriminator (utils/metrics
+    MetricRegistry.snapshot): meters/timers render their count as a counter
+    family plus rate/duration gauges, gauges carry their high-water mark as
+    a second ``_max`` sample, histograms render cumulative ``_bucket{le=}``
+    series with OpenMetrics exemplars (last traced observation per bucket,
+    resolvable against /traces) plus ``_sum``/``_count`` and quantile
+    gauges. Label values are escaped; names sanitized + corda_tpu_ prefix.
+    Entries without a ``type`` fall back to one untyped sample per numeric
+    field (older snapshots, ad-hoc dicts)."""
+    lines: list = []
     for name, fields in sorted(snapshot.items()):
         base = "corda_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", name).lower()
-        for k, v in fields.items():
-            if isinstance(v, bool) or not isinstance(v, (int, float)):
-                continue
-            lines.append(f"{base}_{k} {v}")
+        mtype = fields.get("type") if isinstance(fields, dict) else None
+        if mtype == "meter":
+            _family(lines, f"{base}_count", "counter",
+                    f"Total events of {name}",
+                    [("", None, fields["count"], None)])
+            _family(lines, f"{base}_mean_rate", "gauge",
+                    f"Mean event rate of {name} (1/s)",
+                    [("", None, fields["mean_rate"], None)])
+        elif mtype == "timer":
+            _family(lines, f"{base}_count", "counter",
+                    f"Total timed operations of {name}",
+                    [("", None, fields["count"], None)])
+            _family(lines, f"{base}_mean_s", "gauge",
+                    f"Mean duration of {name} (s)",
+                    [("", None, fields["mean_s"], None)])
+            _family(lines, f"{base}_max_s", "gauge",
+                    f"Max duration of {name} (s)",
+                    [("", None, fields["max_s"], None)])
+        elif mtype == "counter":
+            _family(lines, f"{base}_value", "gauge",
+                    f"Current value of {name}",
+                    [("", None, fields["value"], None)])
+        elif mtype == "gauge":
+            _family(lines, f"{base}_value", "gauge",
+                    f"Current level of {name}",
+                    [("", None, fields["value"], None)])
+            _family(lines, f"{base}_max", "gauge",
+                    f"High-water mark of {name}",
+                    [("", None, fields["max"], None)])
+        elif mtype == "gauge_fn":
+            v = fields.get("value")
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                _family(lines, f"{base}_value", "gauge",
+                        f"Current value of {name}",
+                        [("", None, v, None)])
+        elif mtype == "histogram":
+            exemplars = fields.get("exemplars", {})
+            samples = [("_bucket", [("le", le)], cum, exemplars.get(le))
+                       for le, cum in fields.get("buckets", [])]
+            samples.append(("_sum", None, fields["sum"], None))
+            samples.append(("_count", None, fields["count"], None))
+            _family(lines, base, "histogram",
+                    f"Distribution of {name}", samples)
+            for q in ("max", "mean", "p50", "p90", "p99"):
+                _family(lines, f"{base}_{q}", "gauge",
+                        f"{q} of {name}", [("", None, fields[q], None)])
+        else:
+            # legacy/ad-hoc entry: one untyped sample per numeric field
+            for k, v in (fields.items() if isinstance(fields, dict) else ()):
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                lines.append(f"{base}_{k} {v}")
     return "\n".join(lines) + "\n"
 
 
@@ -127,6 +216,24 @@ class NodeWebServer:
                     else:
                         self._reply_raw(200, *served)
                     return
+                if self.path == "/healthz":   # liveness: we answered
+                    self._reply(200, {"status": "ok"})
+                    return
+                if self.path == "/readyz":    # readiness: see rpc.health()
+                    try:
+                        health = server.handle_readyz()
+                        self._reply(200 if health.get("ready") else 503,
+                                    health)
+                    except Exception as e:
+                        self._reply(503, {"ready": False,
+                                          "error": f"{type(e).__name__}: {e}"})
+                    return
+                if self.path == "/debug/profile":
+                    try:
+                        self._reply(200, server.handle_debug_profile())
+                    except Exception as e:
+                        self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
                 if self.path == "/metrics":   # Prometheus scrape endpoint
                     try:
                         self._reply_raw(
@@ -194,6 +301,25 @@ class NodeWebServer:
         if path == "/api/metrics":
             return self.ops.metrics_snapshot()
         raise RouteNotFound(path)
+
+    def handle_readyz(self) -> dict:
+        """GET /readyz — the node's readiness checks (rpc.health). An ops
+        object without ``health`` (a custom/remote proxy) degrades to ready:
+        the probe should not fail a node it cannot introspect."""
+        health_fn = getattr(self.ops, "health", None)
+        if health_fn is None:
+            return {"ready": True, "checks": {}}
+        return health_fn()
+
+    def handle_debug_profile(self) -> dict:
+        """GET /debug/profile — the kernel flight recorder's snapshot,
+        straight from the process profiler when the ops object does not
+        expose its own (remote proxies do)."""
+        profile_fn = getattr(self.ops, "profile_snapshot", None)
+        if profile_fn is not None:
+            return profile_fn()
+        from ..observability import get_profiler
+        return get_profiler().snapshot()
 
     def handle_traces(self, path: str) -> tuple[str, bytes]:
         """GET /traces — spans from the live tracer's ring buffer.

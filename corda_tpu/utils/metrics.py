@@ -71,7 +71,13 @@ class Histogram:
     Quantiles are bucket-resolution estimates (within ×10^0.25 ≈ 1.78 of
     the true value), clamped to the observed max — the standard
     fixed-bucket trade: O(1) update, O(buckets) snapshot, no per-sample
-    storage, mergeable across processes by summing counts."""
+    storage, mergeable across processes by summing counts.
+
+    Observations may carry a ``trace_id`` (the tracer's, observability/
+    tracing.py): the histogram keeps the LAST exemplar per bucket, so a
+    slow p99 bucket in /metrics links directly to a concrete span in the
+    trace ring — the Dapper "exemplar" pattern, one dict write per traced
+    observation, nothing stored for untraced ones."""
 
     BOUNDS = _HIST_BOUNDS
 
@@ -81,8 +87,10 @@ class Histogram:
         self.count = 0
         self.total = 0.0
         self.max_value = 0.0
+        # bucket index -> (trace_id, value, unix ts): last exemplar only
+        self._exemplars: dict[int, tuple] = {}
 
-    def update(self, value: float) -> None:
+    def update(self, value: float, trace_id: str | None = None) -> None:
         v = float(value)
         idx = bisect.bisect_left(self.BOUNDS, v)
         with self._lock:
@@ -91,6 +99,35 @@ class Histogram:
             self.total += v
             if v > self.max_value:
                 self.max_value = v
+            if trace_id is not None:
+                self._exemplars[idx] = (trace_id, v, time.time())
+
+    def _bucket_le(self, idx: int) -> str:
+        return (f"{self.BOUNDS[idx]:.6g}" if idx < len(self.BOUNDS)
+                else "+Inf")
+
+    def exemplars(self) -> dict:
+        """Last exemplar per bucket: {le: {trace_id, value, ts}} — the
+        /metrics JSON + Prometheus exposition surface."""
+        with self._lock:
+            items = list(self._exemplars.items())
+        return {self._bucket_le(i): {"trace_id": t, "value": v, "ts": ts}
+                for i, (t, v, ts) in sorted(items)}
+
+    def bucket_counts(self) -> list:
+        """Cumulative (le, count) pairs for non-empty buckets plus +Inf —
+        the Prometheus histogram exposition shape."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self.count
+        out = []
+        cum = 0
+        for i, c in enumerate(counts[:-1]):
+            cum += c
+            if c:
+                out.append((self._bucket_le(i), cum))
+        out.append(("+Inf", total))
+        return out
 
     def __enter__(self):
         self._start = time.perf_counter()
@@ -122,10 +159,15 @@ class Histogram:
     def snapshot_fields(self) -> dict:
         with self._lock:
             count, total, max_v = self.count, self.total, self.max_value
-        return {"count": count, "sum": total, "max": max_v,
-                "mean": total / count if count else 0.0,
-                "p50": self.quantile(0.50), "p90": self.quantile(0.90),
-                "p99": self.quantile(0.99)}
+            has_exemplars = bool(self._exemplars)
+        out = {"count": count, "sum": total, "max": max_v,
+               "mean": total / count if count else 0.0,
+               "p50": self.quantile(0.50), "p90": self.quantile(0.90),
+               "p99": self.quantile(0.99),
+               "buckets": self.bucket_counts()}
+        if has_exemplars:
+            out["exemplars"] = self.exemplars()
+        return out
 
 
 class Counter:
@@ -197,21 +239,43 @@ class MetricRegistry:
         with self._lock:
             self._metrics[name] = fn
 
+    def register(self, name: str, metric) -> None:
+        """Install an EXISTING metric object under ``name`` — the seam the
+        kernel profiler uses to share its process-wide histograms with
+        every registry that exports them (node monitoring + bench's
+        private registry see the same distribution)."""
+        with self._lock:
+            self._metrics[name] = metric
+
+    def get_metric(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
     def snapshot(self) -> dict:
+        """Registry → {name: fields} with a ``type`` discriminator per
+        metric, so exporters (prometheus_text) can render each family
+        correctly instead of guessing from field names."""
         out = {}
         with self._lock:
             items = list(self._metrics.items())
         for name, m in items:
             if isinstance(m, Meter):
-                out[name] = {"count": m.count, "mean_rate": m.mean_rate()}
+                out[name] = {"type": "meter", "count": m.count,
+                             "mean_rate": m.mean_rate()}
             elif isinstance(m, Timer):
-                out[name] = {"count": m.count, "mean_s": m.mean_s(), "max_s": m.max_s}
+                out[name] = {"type": "timer", "count": m.count,
+                             "mean_s": m.mean_s(), "max_s": m.max_s}
             elif isinstance(m, Counter):
-                out[name] = {"value": m.value}
+                out[name] = {"type": "counter", "value": m.value}
             elif isinstance(m, Histogram):
-                out[name] = m.snapshot_fields()
+                out[name] = {"type": "histogram", **m.snapshot_fields()}
             elif isinstance(m, Gauge):
-                out[name] = {"value": m.value, "max": m.max_value}
+                out[name] = {"type": "gauge", "value": m.value,
+                             "max": m.max_value}
             else:
-                out[name] = {"value": m()}
+                try:
+                    value = m()
+                except Exception:   # a dead gauge fn must not kill /metrics
+                    value = None
+                out[name] = {"type": "gauge_fn", "value": value}
         return out

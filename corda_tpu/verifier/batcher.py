@@ -26,6 +26,7 @@ JVM (SURVEY.md §5 tracing).
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time as _time
@@ -41,14 +42,22 @@ from ..core.crypto.keys import (
 from ..core.crypto.schemes import (
     ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256, EDDSA_ED25519_SHA512)
 from ..core.crypto.signatures import Crypto
-from ..observability import get_tracer
+from ..observability import get_profiler, get_tracer, jlog
 from ..utils.metrics import MetricRegistry
+
+_log = logging.getLogger(__name__)
 
 _ED = EDDSA_ED25519_SHA512.scheme_number_id
 _K1 = ECDSA_SECP256K1_SHA256.scheme_number_id
 _R1 = ECDSA_SECP256R1_SHA256.scheme_number_id
 
 _BUCKETS = {_ED: "ed25519", _K1: "secp256k1", _R1: "secp256r1"}
+
+
+def _tid(bctx) -> str | None:
+    """Exemplar trace id for the flush's histogram samples (None when the
+    batch is untraced — the histogram just skips the exemplar)."""
+    return getattr(bctx, "trace_id", None)
 
 
 class _Group:
@@ -345,6 +354,8 @@ class SignatureBatcher:
             tracer = get_tracer()
             bctx = self._trace_flush(tracer, bucket, items, reason) \
                 if tracer.enabled else None
+            jlog(_log, "batcher.flush", ctx=bctx, bucket=bucket,
+                 batch_size=len(items), flush_reason=reason)
             if bucket == "host" or len(items) < self.host_crossover:
                 if bucket != "host":
                     self.metrics.meter("SigBatcher.HostRouted").mark(
@@ -355,7 +366,7 @@ class SignatureBatcher:
                                  route="host"):
                     verdicts = self._run_host(items)
                 self.metrics.histogram("verifier_dispatch_seconds").update(
-                    _time.perf_counter() - t0)
+                    _time.perf_counter() - t0, trace_id=_tid(bctx))
                 self._resolve("host", items, verdicts, bctx)
                 return None
             return self._dispatch_device(bucket, items, reason, bctx)
@@ -458,13 +469,17 @@ class SignatureBatcher:
         if self.mesh is not None:
             self._mark_device(items)
             self.metrics.histogram("verifier_dispatch_seconds").update(
-                _time.perf_counter() - t_prep)
+                _time.perf_counter() - t_prep, trace_id=_tid(bctx))
             dspan.set_tag("mesh", True)
             dspan.finish()
             self._resolve(bucket, items, mesh_verdicts, bctx)
             return None
+        t_end = _time.perf_counter()
+        # feed the flight recorder's pipeline view: this prep busy interval
+        # intersected against the finish pool's device-wait intervals
+        get_profiler().overlap.add_prep(t_prep, t_end)
         self.metrics.histogram("verifier_prep_seconds").update(
-            _time.perf_counter() - t_prep)
+            t_end - t_prep, trace_id=_tid(bctx))
         dspan.finish()
         # pipelined: the finish pool blocks on the device result (a
         # GIL-releasing wait) and resolves the futures; this prep worker is
@@ -495,9 +510,11 @@ class SignatureBatcher:
         try:
             with wspan, self.metrics.timer(f"SigBatcher.{bucket}.Duration"):
                 verdicts = finish(pending)
+            t_end = _time.perf_counter()
             self._mark_device(items)
+            get_profiler().overlap.add_device(t0, t_end)
             self.metrics.histogram("verifier_dispatch_seconds").update(
-                _time.perf_counter() - t0)
+                t_end - t0, trace_id=_tid(bctx))
         except Exception:
             self.metrics.meter("SigBatcher.BatchFailure").mark()
             verdicts = self._run_host(items)
@@ -546,7 +563,8 @@ class SignatureBatcher:
         self.metrics.meter("SigBatcher.Checked").mark(len(items))
         self.metrics.counter("SigBatcher.InFlight").dec(len(items))
         dt = _time.perf_counter() - t0
-        self.metrics.histogram("verifier_finish_seconds").update(dt)
+        self.metrics.histogram("verifier_finish_seconds").update(
+            dt, trace_id=_tid(bctx))
         if tracer.enabled:
             tracer.record("batcher.resolve", parent=bctx, start_s=t_wall,
                           duration_s=dt, bucket=bucket,

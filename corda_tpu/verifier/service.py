@@ -71,7 +71,8 @@ class TransactionVerifierService:
                     raise
                 finally:
                     self.metrics.counter("Verification.InFlight").dec()
-                    hist.update(time.perf_counter() - t0)
+                    hist.update(time.perf_counter() - t0,
+                                trace_id=getattr(trace_ctx, "trace_id", None))
 
         return self._pool.submit(work)
 

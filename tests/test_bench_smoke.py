@@ -8,6 +8,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -33,8 +35,12 @@ def test_bench_smoke_emits_full_json_schema():
             "prep_workers", "prep_inflight_depth", "prep_overlap_max",
             "stage_dispatch_ms_p50", "stage_dispatch_ms_p90",
             "stage_dispatch_ms_p99", "stage_finish_ms_p50",
-            "verifier_batch_size_p50"):
+            "verifier_batch_size_p50",
+            # flight-recorder fields (observability/profiling.py)
+            "compile_s_total", "compile_cache_hits",
+            "occupancy_pct_per_scheme", "prep_overlap_pct"):
         assert field in out, f"missing JSON field: {field}"
+    assert isinstance(out["occupancy_pct_per_scheme"], dict)
     assert out["smoke"] is True
     # the service path actually ran: every scheme produced a nonzero rate,
     # and the prep pool saw at least one flush in flight
@@ -44,3 +50,19 @@ def test_bench_smoke_emits_full_json_schema():
                  "mixed_service_path_verifies_per_sec"):
         assert out[rate] > 0, rate
     assert out["prep_overlap_max"] >= 1
+
+
+@pytest.mark.slow
+def test_bench_smoke_guard_gate_passes_end_to_end():
+    """`bench.py --smoke --guard` must exit 0: the regression gate degrades
+    to the schema check on a smoke artifact (tools/benchguard.py), so this
+    is the CI-safe wiring test for the whole measure-then-gate path."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--guard"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "benchguard: ok" in proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["smoke"] is True

@@ -1,0 +1,113 @@
+"""Bench regression gate (tools/benchguard.py): floors fit from the
+trajectory must fail a synthetic regression, pass the repo's real
+BENCH_r*.json history, and degrade to a schema check on smoke artifacts.
+"""
+import json
+
+import pytest
+
+from corda_tpu.tools import benchguard
+
+
+def _artifact(**over):
+    """A minimal full-run artifact satisfying the required-field schema."""
+    base = {
+        "metric": "ecdsa_secp256k1_verifies_per_sec_per_chip",
+        "value": 100.0, "unit": "verifies/s", "vs_baseline": 10.0,
+        "ed25519_verifies_per_sec_per_chip": 1000.0,
+        "secp256r1_verifies_per_sec_per_chip": 50.0,
+        "service_path_verifies_per_sec": 200.0,
+        "ed25519_service_path_verifies_per_sec": 400.0,
+        "secp256r1_service_path_verifies_per_sec": 80.0,
+        "mixed_service_path_verifies_per_sec": 150.0,
+        "tx_verify_p50_ms_batch1": 1.0,
+        "tx_verify_p50_ms_batch1k": 20.0,
+        "compile_s_total": 5.0, "compile_cache_hits": 7,
+        "occupancy_pct_per_scheme": {"ed25519": 90.0},
+        "prep_overlap_pct": 40.0,
+    }
+    base.update(over)
+    return base
+
+
+def test_synthetic_regressing_trajectory_fails():
+    trajectory = [_artifact(), _artifact(value=120.0)]
+    guards = benchguard.fit_guards(trajectory)
+    # best=120, floor=120*0.85=102 — a drop to 90 must trip the gate
+    regressed = _artifact(value=90.0)
+    problems = benchguard.check(regressed, guards)
+    assert problems, "regression not caught"
+    assert any("value: 90" in p and "floor" in p for p in problems)
+
+
+def test_latency_regression_fails_against_ceiling():
+    guards = benchguard.fit_guards([_artifact(tx_verify_p50_ms_batch1=1.0)])
+    slow = _artifact(tx_verify_p50_ms_batch1=1.5)   # ceiling = 1.35
+    problems = benchguard.check(slow, guards)
+    assert any("tx_verify_p50_ms_batch1" in p and "ceiling" in p
+               for p in problems)
+
+
+def test_within_tolerance_passes():
+    guards = benchguard.fit_guards([_artifact(value=100.0)])
+    assert benchguard.check(_artifact(value=90.0), guards) == []
+
+
+def test_smoke_artifact_gets_schema_check_only():
+    guards = benchguard.fit_guards([_artifact(value=1000.0)])
+    # values way below the floors, but smoke => schema-only
+    smoke = _artifact(value=0.0, smoke=True)
+    assert benchguard.check(smoke, guards) == []
+    # ... and the schema check still bites on a missing field
+    broken = dict(smoke)
+    del broken["prep_overlap_pct"]
+    problems = benchguard.check(broken, guards)
+    assert any("prep_overlap_pct" in p for p in problems)
+
+
+def test_schema_rejects_wrong_shapes():
+    bad = _artifact(occupancy_pct_per_scheme=[1, 2],
+                    compile_s_total="fast")
+    problems = benchguard.schema_violations(bad)
+    assert any("occupancy_pct_per_scheme" in p and "dict" in p
+               for p in problems)
+    assert any("compile_s_total" in p for p in problems)
+
+
+def test_smoke_and_zero_rounds_do_not_drag_floors():
+    trajectory = [
+        _artifact(value=0.0, smoke=True),    # smoke round: skipped outright
+        _artifact(value=0.0),                # dead metric: not a floor of 0
+        _artifact(value=100.0),
+    ]
+    guards = benchguard.fit_guards(trajectory)
+    assert guards["value"]["best"] == 100.0
+
+
+def test_real_trajectory_passes_self_replay():
+    """Every recorded round must clear the guards fit from the rounds
+    before it — the tolerances are calibrated to the repo's real noise."""
+    paths = benchguard.default_trajectory_paths()
+    if not paths:
+        pytest.skip("no BENCH_r*.json artifacts in this checkout")
+    trajectory = benchguard.load_trajectory(paths)
+    for i, run in enumerate(trajectory):
+        guards = benchguard.fit_guards(trajectory[:i])
+        value_problems = [p for p in benchguard.check(run, guards)
+                          if "<" in p or ">" in p]
+        assert value_problems == [], f"round {paths[i]}: {value_problems}"
+
+
+def test_cli_replays_trajectory(capsys):
+    if not benchguard.default_trajectory_paths():
+        pytest.skip("no BENCH_r*.json artifacts in this checkout")
+    assert benchguard.main([]) == 0
+    assert "ok" in capsys.readouterr().out
+
+
+def test_guard_current_with_explicit_paths(tmp_path):
+    p = tmp_path / "BENCH_r01.json"
+    p.write_text(json.dumps({"parsed": _artifact(value=200.0)}))
+    problems = benchguard.guard_current(_artifact(value=100.0), [str(p)])
+    assert any("value: 100" in x for x in problems)
+    assert benchguard.guard_current(_artifact(value=190.0), [str(p)]) == []

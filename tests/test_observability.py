@@ -64,7 +64,9 @@ def test_histogram_in_registry_snapshot_and_prometheus():
     snap = reg.snapshot()
     assert snap["tx_verify_seconds"]["count"] == 1
     assert set(snap["tx_verify_seconds"]) == {
-        "count", "sum", "max", "mean", "p50", "p90", "p99"}
+        "type", "count", "sum", "max", "mean", "p50", "p90", "p99",
+        "buckets"}
+    assert snap["tx_verify_seconds"]["type"] == "histogram"
     text = prometheus_text(snap)
     assert "corda_tpu_tx_verify_seconds_count 1" in text
     assert "corda_tpu_tx_verify_seconds_p99" in text
@@ -106,6 +108,48 @@ def test_span_ring_caps_and_exports(tmp_path):
     assert ring.export_jsonl(str(path)) == 4
     lines = [json.loads(l) for l in path.read_text().splitlines()]
     assert [s["name"] for s in lines] == ["s3", "s4", "s5", "s6"]
+
+
+def test_span_ring_survives_concurrent_writers():
+    """N threads hammering one ring: no exception, the ring holds exactly
+    `capacity` spans, and drop accounting balances the total written."""
+    ring = SpanRing(capacity=32)
+    n_threads, per_thread = 8, 200
+
+    def writer(t):
+        for i in range(per_thread):
+            ring.record({"name": f"w{t}-{i}", "trace_id": "t",
+                         "span_id": f"{t}-{i}"})
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(ring) == 32
+    assert ring.dropped == n_threads * per_thread - 32
+    assert len(ring.snapshot()) == 32
+
+
+def test_spans_dropped_surfaces_as_registry_gauge():
+    """The ServiceHub monitoring registry exposes the ring's drop counter
+    (Tracing.SpansDropped) so an overflowing flight recorder is visible on
+    /metrics instead of silently losing history."""
+    from corda_tpu.testing import MockNetwork
+    tracer = enable_tracing(capacity=4)
+    network = MockNetwork()
+    node = network.create_node("O=Drops, L=Oslo, C=NO")
+    network.start_nodes()
+    for i in range(10):            # 10 spans into a 4-slot ring → 6 drops
+        tracer.record(f"s{i}")
+    snap = node.services.monitoring.snapshot()
+    assert snap["Tracing.SpansDropped"]["value"] == 6
+    assert snap["Tracing.SpansBuffered"]["value"] == 4
+    disable_tracing()              # no-op tracer has no ring: gauges read 0
+    snap = node.services.monitoring.snapshot()
+    assert snap["Tracing.SpansDropped"]["value"] == 0
+    assert snap["Tracing.SpansBuffered"]["value"] == 0
 
 
 def test_error_inside_span_is_tagged():

@@ -1,0 +1,174 @@
+"""Kernel flight recorder (observability/profiling.py) unit coverage:
+compile-cache accounting, batch-occupancy math, prep/device overlap
+intervals, exemplar attach/expose, and registry publication.
+"""
+import numpy as np
+import pytest
+
+from corda_tpu.observability import (KernelProfiler, OverlapTracker,
+                                     disable_tracing, enable_tracing)
+from corda_tpu.utils.metrics import Histogram, MetricRegistry
+
+
+# -- OverlapTracker ----------------------------------------------------------
+
+def test_overlap_hand_fed_intervals():
+    t = OverlapTracker()
+    t.add_prep(0.0, 10.0)
+    t.add_device(5.0, 15.0)
+    snap = t.snapshot()
+    assert snap["prep_busy_s"] == pytest.approx(10.0)
+    assert snap["device_busy_s"] == pytest.approx(10.0)
+    assert snap["overlap_s"] == pytest.approx(5.0)
+    assert snap["overlap_pct"] == pytest.approx(50.0)
+
+
+def test_overlap_merges_overlapping_intervals():
+    t = OverlapTracker()
+    # two prep intervals that merge into [0, 4]; device [1, 3] is fully
+    # covered — overlap must not double-count the merged region
+    t.add_prep(0.0, 2.5)
+    t.add_prep(2.0, 4.0)
+    t.add_device(1.0, 3.0)
+    snap = t.snapshot()
+    assert snap["prep_busy_s"] == pytest.approx(4.0)
+    assert snap["overlap_s"] == pytest.approx(2.0)
+    assert snap["overlap_pct"] == pytest.approx(100.0)
+
+
+def test_overlap_no_device_time_is_zero_pct():
+    t = OverlapTracker()
+    t.add_prep(0.0, 1.0)
+    assert t.overlap_pct() == 0.0
+    t.add_prep(1.0, 0.5)        # inverted interval is dropped
+    assert t.snapshot()["prep_busy_s"] == pytest.approx(1.0)
+
+
+# -- compile accounting ------------------------------------------------------
+
+def test_jit_compile_then_cache_hit():
+    jax = pytest.importorskip("jax")
+    prof = KernelProfiler()
+    fn = jax.jit(lambda x: x + 1)
+    x = np.zeros(4, np.int32)
+    prof.call("k", fn, x)                       # first shape: compiles
+    prof.call("k", fn, np.ones(4, np.int32))    # same shape: cache hit
+    prof.call("k", fn, np.zeros(8, np.int32))   # new shape: compiles again
+    totals = prof.compile_totals()
+    assert totals["compiles"] == 2
+    assert totals["compile_cache_hits"] == 1
+    assert totals["compile_s_total"] > 0
+    st = prof.snapshot()["kernels"]["k"]
+    assert st["dispatches"] == 3
+    # compile wall time was booked to the compile bucket, not dispatch
+    assert prof.compile_hist.count == 2
+    assert prof.dispatch_hist.count == 1
+
+
+def test_signature_fallback_for_plain_callables():
+    prof = KernelProfiler()
+    calls = []
+
+    def fn(a):
+        calls.append(a.shape)
+        return a
+
+    prof.call("plain", fn, np.zeros((4, 2)))
+    prof.call("plain", fn, np.ones((4, 2)))     # same shape/dtype: hit
+    prof.call("plain", fn, np.zeros((8, 2)))    # novel shape: "compile"
+    totals = prof.compile_totals()
+    assert totals["compiles"] == 2
+    assert totals["compile_cache_hits"] == 1
+    assert len(calls) == 3
+
+
+def test_compile_emits_span_when_tracing():
+    tracer = enable_tracing()
+    try:
+        prof = KernelProfiler()
+        prof.call("spanned", lambda a: a, np.zeros(3), capacity=8)
+        spans = [s for s in tracer.spans() if s["name"] == "kernel.compile"]
+        assert len(spans) == 1
+        assert spans[0]["tags"]["kernel"] == "spanned"
+        assert spans[0]["tags"]["batch_capacity"] == 8
+    finally:
+        disable_tracing()
+
+
+# -- occupancy ---------------------------------------------------------------
+
+def test_occupancy_math_matches_hand_computed_padding():
+    prof = KernelProfiler()
+    prof.record_occupancy("ed25519", live=3, capacity=8)
+    assert prof.occupancy_pct_per_scheme() == {"ed25519": 37.5}
+    prof.record_occupancy("ed25519", live=5, capacity=8)
+    # aggregate: (3 + 5) / (8 + 8) = 50%
+    assert prof.occupancy_pct_per_scheme() == {"ed25519": 50.0}
+    occ = prof.snapshot()["occupancy"]["ed25519"]
+    assert occ["live_total"] == 8 and occ["capacity_total"] == 16
+    assert occ["last_batch_pct"] == 62.5
+
+
+def test_occupancy_recorded_through_call():
+    prof = KernelProfiler()
+    prof.call("k1", lambda a: a, np.zeros(3), live=3, capacity=4,
+              scheme="secp256r1")
+    assert prof.occupancy_pct_per_scheme() == {"secp256r1": 75.0}
+
+
+# -- device-wait attribution -------------------------------------------------
+
+def test_pending_handle_attribution():
+    prof = KernelProfiler()
+    out = prof.call("kern", lambda a: a + 1, np.zeros(3))
+    assert prof.pending_name(out) == "kern"
+    assert prof.pending_name(out) == "unknown"   # popped on first lookup
+    prof.device_wait("kern", 0.25)
+    st = prof.snapshot()["kernels"]["kern"]
+    assert st["device_waits"] == 1
+    assert st["device_wait_s"] == pytest.approx(0.25)
+
+
+# -- exemplars ---------------------------------------------------------------
+
+def test_exemplar_attach_and_expose():
+    h = Histogram()
+    h.update(0.01, trace_id="aaaa000011112222")
+    h.update(0.01)                               # untraced: keeps exemplar
+    h.update(0.01, trace_id="bbbb000011112222")  # last-wins per bucket
+    h.update(3.0, trace_id="cccc000011112222")
+    ex = h.exemplars()
+    assert set(ex) == {"0.01", "3.16228"}
+    assert ex["0.01"]["trace_id"] == "bbbb000011112222"
+    assert ex["3.16228"]["trace_id"] == "cccc000011112222"
+    assert ex["0.01"]["value"] == pytest.approx(0.01)
+    fields = h.snapshot_fields()
+    assert fields["exemplars"] == ex             # the /metrics JSON surface
+    # untraced-only histograms carry no exemplars key at all
+    assert "exemplars" not in Histogram().snapshot_fields()
+
+
+def test_exemplar_resolves_in_prometheus_text():
+    from corda_tpu.tools.webserver import prometheus_text
+    reg = MetricRegistry()
+    reg.histogram("verifier_dispatch_seconds").update(
+        0.02, trace_id="feedface00000001")
+    text = prometheus_text(reg.snapshot())
+    assert '# {trace_id="feedface00000001"} 0.02' in text
+
+
+# -- registry publication ----------------------------------------------------
+
+def test_publish_mirrors_into_registry():
+    prof = KernelProfiler()
+    prof.record_occupancy("ed25519", live=6, capacity=8)
+    prof.overlap.add_prep(0.0, 2.0)
+    prof.overlap.add_device(1.0, 3.0)
+    reg = MetricRegistry()
+    prof.publish(reg)
+    snap = reg.snapshot()
+    assert snap["Profiler.ed25519.OccupancyPct"]["value"] == 75.0
+    assert snap["Profiler.PrepOverlapPct"]["value"] == pytest.approx(50.0)
+    assert snap["Profiler.CompileSecondsTotal"]["value"] == 0
+    # the registry shares the profiler's histogram OBJECT, not a copy
+    assert reg.get_metric("kernel_dispatch_seconds") is prof.dispatch_hist

@@ -1,0 +1,116 @@
+"""SpanContext propagation across the TCP messaging plane.
+
+The wire frame carries an optional fifth [trace_id, span_id] element
+(network/tcp.py); a receive handler parenting its span on ``Message.trace``
+stitches both hosts' spans into ONE connected trace — the cross-host half
+of the flight-recorder story (statemachine → batcher → notary spans already
+connect in-process through explicit SpanContext passing).
+"""
+import time
+
+import pytest
+
+from corda_tpu.network.messaging import TopicSession
+from corda_tpu.network.tcp import TcpMessagingService
+from corda_tpu.observability import (disable_tracing, enable_tracing,
+                                     get_tracer)
+
+
+def _wait_for(pred, timeout=8.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+@pytest.fixture
+def plane():
+    """Two plaintext endpoints wired through a shared directory."""
+    directory = {}
+    resolve = directory.get
+    a = TcpMessagingService("alice", "127.0.0.1", 0, resolve)
+    b = TcpMessagingService("bob", "127.0.0.1", 0, resolve)
+    directory["alice"] = ("127.0.0.1", a.port)
+    directory["bob"] = ("127.0.0.1", b.port)
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+def test_transport_advertises_trace_support(plane):
+    a, _ = plane
+    assert a.supports_trace is True
+
+
+def test_trace_rides_the_frame(plane):
+    a, b = plane
+    got = []
+    b.add_message_handler(TopicSession("t", 1), got.append)
+    a.send(TopicSession("t", 1), b"traced", "bob",
+           trace=("deadbeef01020304", "cafe050607080900"))
+    a.send(TopicSession("t", 1), b"plain", "bob")
+    assert _wait_for(lambda: len(got) == 2)
+    assert got[0].trace == ("deadbeef01020304", "cafe050607080900")
+    assert got[0].data == b"traced"
+    # an untraced send must decode as a four-element frame: no trace
+    assert got[1].trace is None
+
+
+def _roundtrip_connected_trace(a, b):
+    """Shared body: send a->b under a live tracer, parent the receive span
+    on the wire trace, and assert BOTH spans land in one connected trace."""
+    tracer = enable_tracing()
+    try:
+        got = []
+
+        def on_message(msg):
+            with get_tracer().span("session.receive", parent=msg.trace):
+                got.append(msg)
+
+        b.add_message_handler(TopicSession("t", 1), on_message)
+        send_span = tracer.span("session.send", peer="bob")
+        a.send(TopicSession("t", 1), b"hello", "bob",
+               trace=send_span.context().as_tuple())
+        send_span.finish()
+        assert _wait_for(lambda: got)
+
+        trace = tracer.trace(send_span.trace_id)
+        assert sorted(s["name"] for s in trace) == \
+            ["session.receive", "session.send"]
+        receive = next(s for s in trace if s["name"] == "session.receive")
+        assert receive["parent_id"] == send_span.span_id
+    finally:
+        disable_tracing()
+
+
+def test_roundtrip_yields_one_connected_trace(plane):
+    a, b = plane
+    _roundtrip_connected_trace(a, b)
+
+
+def test_mtls_roundtrip_yields_one_connected_trace(tmp_path):
+    """The satellite's acceptance shape: a two-node mutual-TLS round-trip
+    produces one connected trace — the trace element survives the TLS
+    transport exactly as it does plaintext."""
+    pytest.importorskip("cryptography")
+    from corda_tpu.network.tls import TlsConfig
+
+    directory = {}
+    resolve = directory.get
+    a = TcpMessagingService(
+        "alice", "127.0.0.1", 0, resolve,
+        tls=TlsConfig.dev(str(tmp_path / "alice"), "alice",
+                          str(tmp_path / "ca")))
+    b = TcpMessagingService(
+        "bob", "127.0.0.1", 0, resolve,
+        tls=TlsConfig.dev(str(tmp_path / "bob"), "bob",
+                          str(tmp_path / "ca")))
+    directory["alice"] = ("127.0.0.1", a.port)
+    directory["bob"] = ("127.0.0.1", b.port)
+    try:
+        _roundtrip_connected_trace(a, b)
+    finally:
+        a.stop()
+        b.stop()

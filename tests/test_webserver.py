@@ -73,3 +73,52 @@ def test_rest_surface(web):
         assert r.headers["Content-Type"].startswith("text/plain")
     assert "corda_tpu_flows_started_count" in text
     assert "corda_tpu_flows_inflight_value 0" in text
+    # the exposition carries HELP/TYPE metadata per family
+    assert "# TYPE corda_tpu_flows_started_count counter" in text
+    assert "# HELP corda_tpu_flows_started_count" in text
+
+
+def test_health_surface(web):
+    network, alice, server = web
+
+    # liveness: always 200 once the server answers at all
+    assert _get(server, "/healthz") == {"status": "ok"}
+
+    # a mock node carries no verifier batcher: readiness is vacuous but the
+    # notary directory check still reports
+    ready = _get(server, "/readyz")
+    assert ready["ready"] is True
+
+    # the profiler snapshot rides /debug/profile
+    prof = _get(server, "/debug/profile")
+    for key in ("kernels", "occupancy", "overlap", "compile_s_total",
+                "compile_cache_hits"):
+        assert key in prof
+
+
+def test_readyz_tracks_batcher_dispatcher(web):
+    """With a batching verifier installed, /readyz reflects the dispatcher
+    thread's liveness: 200 while it runs, 503 once it is closed."""
+    from corda_tpu.verifier.batcher import SignatureBatcher
+    from corda_tpu.verifier.service import TpuTransactionVerifierService
+    network, alice, server = web
+    svc = TpuTransactionVerifierService(
+        workers=1, batcher=SignatureBatcher(use_device=False))
+    alice.services.verifier_service = svc
+    try:
+        ready = _get(server, "/readyz")
+        assert ready["ready"] is True
+        assert ready["checks"]["batcher_dispatcher_alive"] is True
+
+        svc.batcher.close()
+        try:
+            _get(server, "/readyz")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            body = json.loads(e.read())
+            assert body["ready"] is False
+            assert body["checks"]["batcher_dispatcher_alive"] is False
+    finally:
+        alice.services.verifier_service = None
+        svc.shutdown()
