@@ -270,6 +270,16 @@ def service_metrics(k1_items, ed_items, r1_items):
             assert all(batcher.submit_group(sub).result(timeout=120))
             mid.append(time.perf_counter() - t0)
         p50_1k_ms = sorted(mid)[len(mid) // 2] * 1000.0
+        # the numbers above are only device numbers if the device was
+        # actually used: an open breaker means some batches silently took
+        # the host path, which would corrupt the bench without failing it
+        breakers = batcher.breaker_status()
+        tripped = {s: st for s, st in breakers.items()
+                   if st["state"] != "closed" or st["trips"]}
+        if tripped:
+            print(f"BENCH INVALID: device circuit breaker engaged during "
+                  f"the run: {tripped}", file=sys.stderr)
+            sys.exit(1)
     finally:
         batcher.close()
     # per-stage latency breakdown (prep / dispatch / finish percentiles)

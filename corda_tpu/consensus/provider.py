@@ -10,18 +10,43 @@ from __future__ import annotations
 import concurrent.futures
 
 from ..node.notary import UniquenessException
+from ..utils import retry
+
+
+class _LeaderUnknown(RuntimeError):
+    """Transient leaderless window — retried by consensus_commit."""
 
 
 def consensus_commit(backend, states, tx_id, caller: str,
                      timeout_s: float) -> None:
     """Submit a put_all to `backend` (RaftNode or BFTClient) and block until
     the replicated state machine answers; abandon the pending entry on
-    timeout so the request table cannot leak."""
-    fut = backend.submit(("put_all", [tx_id, list(states), caller]))
-    try:
-        result = fut.result(timeout=timeout_s)
-    except concurrent.futures.TimeoutError:
-        backend.abandon(fut)
-        raise
+    timeout so the request table cannot leak.
+
+    A leaderless window (mid-election, or the leader just died) surfaces
+    as ``RuntimeError("no raft leader known")`` from submit() — that is
+    transient by construction, so the submission retries with
+    decorrelated-jitter backoff inside the caller's timeout budget
+    instead of failing the whole notarisation."""
+
+    def _submit():
+        fut = backend.submit(("put_all", [tx_id, list(states), caller]))
+        try:
+            return fut.result(timeout=timeout_s)
+        except concurrent.futures.TimeoutError:
+            backend.abandon(fut)
+            raise
+        except RuntimeError as e:
+            # only the leadership errors are retryable; anything else
+            # (a replica bug, a closed backend) propagates immediately
+            if "leader" in str(e):
+                raise _LeaderUnknown(str(e)) from e
+            raise
+
+    result = retry.retry_call(
+        _submit, site="raft.submit",
+        policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5, max_attempts=6,
+                                 deadline_s=timeout_s),
+        retry_on=(_LeaderUnknown,))
     if not result["committed"]:
         raise UniquenessException(result["conflicts"])

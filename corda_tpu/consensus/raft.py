@@ -239,6 +239,10 @@ class RaftNode:
             self._send_append(peer)
 
     def _send_append(self, peer: str) -> None:
+        from ..utils.faults import DROP, fault_point
+        if fault_point("raft.append",
+                       detail=f"{self.node_id}->{peer}") == DROP:
+            return   # injected replication loss: the retry tick re-sends
         next_i = self._next_index.get(peer, self.state.last_index() + 1)
         prev = next_i - 1
         entries = tuple(self.state.log[prev:])

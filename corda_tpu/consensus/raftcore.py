@@ -257,7 +257,12 @@ class NativeRaftNode:
                 self._post(self.names[view.peer], VoteResponse(
                     view.a, self.node_id, bool(view.flag)))
             elif kind == _ACT_SEND_APPEND:
-                self._post(self.names[view.peer], AppendEntries(
+                from ..utils.faults import DROP, fault_point
+                peer_name = self.names[view.peer]
+                if fault_point("raft.append",
+                               detail=f"{self.node_id}->{peer_name}") == DROP:
+                    continue   # injected loss: the core's tick re-sends
+                self._post(peer_name, AppendEntries(
                     view.a, self.node_id, view.b, view.c,
                     _unpack_entries(data), view.d))
             elif kind == _ACT_SEND_APPEND_RESPONSE:

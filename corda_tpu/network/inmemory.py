@@ -13,6 +13,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Callable
 
+from ..utils.faults import DROP, DUPLICATE, fault_point
 from .messaging import (HandlerTable, Message, MessagingService,
                         MessageHandlerRegistration, TopicSession)
 
@@ -59,7 +60,13 @@ class InMemoryMessagingNetwork:
         self.sent_log.append(transfer)
         if self.transfer_filter is not None and not self.transfer_filter(transfer):
             return  # dropped
+        # seeded chaos seam: partitions target detail="sender->recipient"
+        act = fault_point("net.send", detail=f"{sender}->{recipient}")
+        if act == DROP:
+            return
         self._queues[recipient].append(transfer)
+        if act == DUPLICATE:
+            self._queues[recipient].append(transfer)
 
     # -- pumping ------------------------------------------------------------
     def pump_receive(self, recipient: str) -> MessageTransfer | None:

@@ -32,7 +32,9 @@ import threading
 from typing import Callable
 
 from ..core.serialization import deserialize, serialize
+from ..utils import retry
 from ..utils.affinity import SerialExecutor
+from ..utils.faults import DROP, DUPLICATE, fault_point
 from .messaging import (HandlerTable, Message, MessagingService,
                         MessageHandlerRegistration, TopicSession)
 
@@ -48,6 +50,15 @@ class MessageSizeExceededError(ValueError):
     """A frame exceeded the plane's max_frame cap. Raised synchronously to
     LOCAL senders; an oversized INBOUND length header closes the connection
     (the length cannot be trusted, so the stream is unrecoverable)."""
+
+
+class MessagingStartupError(RuntimeError):
+    """The messaging plane's listener failed to come up (port already
+    bound, bad TLS material, loop thread wedged). Raised from the
+    CONSTRUCTOR so a node never runs on a half-started transport; the
+    underlying OS error rides ``__cause__``."""
+
+
 MAX_SEND_ATTEMPTS = 10
 MAX_PENDING_FRAMES = 10_000       # per-peer outbound bound (backpressure)
 BACKPRESSURE_TIMEOUT_S = 30.0
@@ -88,15 +99,30 @@ class TcpMessagingService(MessagingService):
         self._loop = asyncio.new_event_loop()
         self._server = None
         self._started = threading.Event()
+        self._startup_error: BaseException | None = None
         self._thread = threading.Thread(target=self._run_loop, daemon=True,
                                         name=f"tcp-messaging({my_name})")
         self._thread.start()
-        self._started.wait(timeout=10)
+        if not self._started.wait(timeout=10):
+            raise MessagingStartupError(
+                f"messaging plane for {my_name} did not start within 10s")
+        if self._startup_error is not None:
+            raise MessagingStartupError(
+                f"messaging plane for {my_name} failed to bind "
+                f"{host}:{port}: {self._startup_error}"
+            ) from self._startup_error
 
     # -- loop plumbing -------------------------------------------------------
     def _run_loop(self) -> None:
         asyncio.set_event_loop(self._loop)
-        self._loop.run_until_complete(self._start_server())
+        try:
+            self._loop.run_until_complete(self._start_server())
+        except BaseException as e:
+            # a bind/TLS failure must reach the constructor, not die in a
+            # daemon thread with the caller holding a zombie service
+            self._startup_error = e
+            self._started.set()
+            return
         self._started.set()
         self._loop.run_forever()
 
@@ -213,12 +239,25 @@ class TcpMessagingService(MessagingService):
         await q.put(frame)
 
     async def _sender(self, recipient: str, q: "asyncio.Queue") -> None:
+        policy = retry.RetryPolicy(base_s=0.05, cap_s=REDELIVERY_DELAY_S,
+                                   max_attempts=MAX_SEND_ATTEMPTS)
+        retry_meter = retry.registry().meter("Retry.Attempts.tcp.send")
+        retry_total = retry.registry().get_metric("Retry.Attempts")
         while True:
             frame = await q.get()
+            # fresh decorrelated-jitter schedule per frame: retries back off
+            # growing-and-jittered instead of in REDELIVERY_DELAY_S lockstep
+            backoff = retry.delays(policy)
             for attempt in range(MAX_SEND_ATTEMPTS):
                 try:
+                    act = fault_point("tcp.send",
+                                      detail=f"{self._name}->{recipient}")
+                    if act == DROP:
+                        break            # injected network loss: frame gone
                     writer = await self._writer_for(recipient)
                     writer.write(frame)
+                    if act == DUPLICATE:
+                        writer.write(frame)
                     await writer.drain()
                     break
                 except (OSError, ConnectionError, LookupError) as e:
@@ -229,7 +268,9 @@ class TcpMessagingService(MessagingService):
                         if hook is not None:
                             self.executor.execute(lambda: hook(recipient))
                         break
-                    await asyncio.sleep(REDELIVERY_DELAY_S)
+                    retry_meter.mark()
+                    retry_total.mark()
+                    await asyncio.sleep(next(backoff))
 
     async def _writer_for(self, recipient: str) -> asyncio.StreamWriter:
         writer = self._writers.get(recipient)
@@ -238,6 +279,7 @@ class TcpMessagingService(MessagingService):
         addr = self.resolve_address(recipient)
         if addr is None:
             raise LookupError(f"no address known for {recipient!r}")
+        fault_point("tcp.connect", detail=f"{self._name}->{recipient}")
         host, port = addr
         reader, writer = await asyncio.open_connection(
             host, port, ssl=self.tls.client_ctx if self.tls is not None else None)
@@ -266,19 +308,28 @@ class TcpMessagingService(MessagingService):
             self._writers.pop(recipient, None)
         writer.close()
         # liveness probe: a transient drop reconnects; refusal means the
-        # peer process is dead → surface to on_send_failure (feed cleanup)
-        await asyncio.sleep(0.2)
-        addr = self.resolve_address(recipient)
+        # peer process is dead → surface to on_send_failure (feed cleanup).
+        # Probed a few times with decorrelated-jitter backoff so a peer
+        # mid-restart is not declared dead on its first refused dial.
+        policy = retry.RetryPolicy(base_s=0.1, cap_s=0.4, max_attempts=3)
+        backoff = retry.delays(policy)
+        probe_meter = retry.registry().meter("Retry.Attempts.tcp.probe")
         probe_failed = True
-        if addr is not None:
+        for _ in range(policy.max_attempts):
+            await asyncio.sleep(next(backoff))
+            addr = self.resolve_address(recipient)
+            if addr is None:
+                continue
             try:
                 _, probe = await asyncio.open_connection(
                     addr[0], addr[1],
                     ssl=self.tls.client_ctx if self.tls is not None else None)
                 probe.close()
                 probe_failed = False
+                break
             except Exception:
-                pass
+                probe_meter.mark()
+                retry.registry().get_metric("Retry.Attempts").mark()
         if probe_failed:
             log.info("peer %s disconnected and is unreachable", recipient)
             hook = self.on_send_failure

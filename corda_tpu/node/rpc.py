@@ -207,8 +207,14 @@ class CordaRPCOps:
     # -- monitoring ----------------------------------------------------------
     def metrics_snapshot(self) -> dict:
         """The node's metric registry (the JMX-export analog: verification
-        timers/meters, batcher counters, flow rates)."""
-        return self.hub.monitoring.snapshot()
+        timers/meters, batcher counters, flow rates), merged with the
+        process-wide retry counters (utils.retry keeps its own registry —
+        its call sites have no ServiceHub) so ``Retry.Attempts.*`` rides
+        /metrics and /api/metrics alongside the node families."""
+        from ..utils import retry
+        merged = dict(retry.snapshot())
+        merged.update(self.hub.monitoring.snapshot())
+        return merged
 
     def health(self) -> dict:
         """Readiness payload for /readyz: named pass/fail checks plus the
@@ -216,6 +222,7 @@ class CordaRPCOps:
         exists — a host-only node is not held unready for cold device
         tables, a non-notary node not for raft state."""
         checks: dict = {}
+        degraded: dict = {}
         svc = self.hub.verifier_service
         batcher = getattr(svc, "batcher", None)
         if batcher is not None:
@@ -228,6 +235,17 @@ class CordaRPCOps:
                 # unless the committed-table cache is already warm
                 from ..ops.field import _DEVICE_TABLE_CACHE
                 checks["device_tables_warm"] = bool(_DEVICE_TABLE_CACHE)
+            status = getattr(batcher, "breaker_status", None)
+            if status is not None:
+                breakers = status()
+                open_schemes = {name: st for name, st in breakers.items()
+                                if st["state"] != "closed"}
+                if open_schemes:
+                    # DEGRADED, not unready: an open breaker means that
+                    # scheme verifies on host — slower, still correct —
+                    # so the node keeps taking traffic while operators
+                    # see exactly which breaker tripped
+                    degraded["device_breakers"] = open_schemes
         notary = getattr(self.hub, "notary_service", None)
         if notary is not None:
             raft = getattr(notary.uniqueness, "raft", None)
@@ -236,7 +254,10 @@ class CordaRPCOps:
         else:
             # non-notary node: ready means it can REACH a notary
             checks["notary_known"] = bool(self.notary_identities())
-        return {"ready": all(checks.values()), "checks": checks}
+        out = {"ready": all(checks.values()), "checks": checks}
+        if degraded:
+            out["degraded"] = degraded
+        return out
 
     def profile_snapshot(self) -> dict:
         """The kernel flight recorder's full state (/debug/profile):

@@ -31,6 +31,7 @@ from ..flows.api import (ExecuteOnce, FlowException, FlowLogic, FlowSession,
                          get_initiated_flow_factory)
 from ..network.messaging import TOPIC_P2P, TopicSession
 from ..observability import get_tracer, jlog
+from ..utils.faults import DROP, fault_point
 from .checkpoints import Checkpoint, CheckpointStorage, SessionSnapshot
 
 _log = logging.getLogger(__name__)
@@ -771,7 +772,12 @@ class StateMachineManager:
         if monitoring is not None and fsm.run_id in self.flows:
             monitoring.meter("Flows.Finished").mark()
             monitoring.counter("Flows.InFlight").dec()
-        self.checkpoints.remove_checkpoint(fsm.run_id)
+        # crash-consistency seam: a "drop" rule here models a process kill
+        # AFTER the flow's sends went out but BEFORE the checkpoint was
+        # removed — the surviving artifact of exactly that crash window.
+        # Restart must replay the checkpoint idempotently (no re-sends).
+        if fault_point("smm.checkpoint_remove", detail=fsm.run_id) != DROP:
+            self.checkpoints.remove_checkpoint(fsm.run_id)
         self.flows.pop(fsm.run_id, None)
         self._cleanup_sessions(fsm)
         # auto-release any vault soft locks held under this flow's id —

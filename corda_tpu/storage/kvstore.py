@@ -18,6 +18,8 @@ import struct
 import threading
 import zlib
 
+from ..utils.faults import fault_point
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _NATIVE_PATHS = [
     os.path.join(_HERE, "..", "..", "native", "libkvlog.so"),
@@ -215,6 +217,9 @@ class KvStore:
         with self._lock:
             self._check_usable(key, value)
             try:
+                # durability seam: chaos rules raise SyncFailure here to
+                # exercise the fail-stop discipline without breaking a disk
+                fault_point("kvstore.flush", detail="set")
                 self._engine.append(key, value, False)
             except SyncFailure:
                 self._failed = True
@@ -240,6 +245,7 @@ class KvStore:
             if key not in self._index:
                 raise KeyError(key)
             try:
+                fault_point("kvstore.flush", detail="del")
                 self._engine.append(key, b"", True)
             except SyncFailure:
                 self._failed = True

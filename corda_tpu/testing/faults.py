@@ -1,0 +1,20 @@
+"""Public face of the fault-injection harness (docs/ROBUSTNESS.md).
+
+The implementation lives in ``corda_tpu.utils.faults`` so that production
+modules (the TCP plane, the batcher, raft) can import ``fault_point``
+without pulling in the ``corda_tpu.testing`` package — whose ``__init__``
+imports MockNetwork and, transitively, most of the node — which would be
+an import cycle. Tests import from here:
+
+    from corda_tpu.testing.faults import FaultRule, inject
+
+    with inject(FaultRule("tcp.send", "drop", count=3), seed=7) as inj:
+        ...
+        assert inj.fired("tcp.send") == 3
+"""
+from ..utils.faults import (DROP, DUPLICATE, FaultError, FaultInjector,
+                            FaultRule, active, arm, disarm, fault_point,
+                            inject)
+
+__all__ = ["DROP", "DUPLICATE", "FaultError", "FaultInjector", "FaultRule",
+           "active", "arm", "disarm", "fault_point", "inject"]

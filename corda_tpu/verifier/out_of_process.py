@@ -30,6 +30,8 @@ from typing import Any
 from ..core.serialization import deserialize, register_type, serialize
 from ..network.messaging import (TOPIC_VERIFIER_REQUESTS,
                                  TOPIC_VERIFIER_RESPONSES, TopicSession)
+from ..utils import retry
+from ..utils.faults import DROP, fault_point
 from ..utils.metrics import MetricRegistry
 from .service import TransactionVerifierService
 
@@ -183,8 +185,23 @@ class VerifierRequestQueue:
                 self._outstanding[worker].append(req)
                 self._dealt_at[req.verification_id] = (worker,
                                                        time.monotonic())
-            self.network_service.send(TopicSession(TOPIC_VERIFIER_REQUESTS),
-                                      serialize(req), worker)
+            try:
+                # a "drop" rule here models a lost delivery (the worker
+                # never sees the request): the redelivery-timeout scan is
+                # what recovers it — exactly the path chaos tests pin down
+                if fault_point("oop.deliver", detail=f"->{worker}") == DROP:
+                    continue
+                self.network_service.send(
+                    TopicSession(TOPIC_VERIFIER_REQUESTS),
+                    serialize(req), worker)
+            except Exception:
+                # a SEND failure is a live crash signal — detach now and
+                # requeue everything the worker held (this request
+                # included), instead of waiting out redelivery_timeout_s
+                log.warning("delivering to verifier %s failed; detaching",
+                            worker, exc_info=True)
+                self.detach_worker(worker)
+                return   # detach_worker re-drained onto the survivors
 
 
 class OutOfProcessTransactionVerifierService(TransactionVerifierService):
@@ -207,6 +224,20 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
             TopicSession(TOPIC_VERIFIER_RESPONSES), self._on_response)
         self.metrics.gauge("Verification.InFlightOOP",
                            lambda: len(self._handles))
+        # transport-level crash detection: the TCP plane reports abandoned
+        # sends via on_send_failure — chain it into an immediate
+        # detach-and-requeue so a crashed worker costs one redelivery, not
+        # a redelivery_timeout_s wait. Detaching an address that is not a
+        # worker is a no-op, so sharing the hook is safe.
+        if hasattr(network_service, "on_send_failure"):
+            prev_hook = network_service.on_send_failure
+
+            def _send_failed(recipient, _prev=prev_hook):
+                if _prev is not None:
+                    _prev(recipient)
+                self.queue.detach_worker(recipient)
+
+            network_service.on_send_failure = _send_failed
         if redelivery_timeout_s is not None:
             self._scanner = threading.Thread(
                 target=self._scan_overdue, daemon=True,
@@ -321,15 +352,25 @@ class VerifierWorker:
                 while self._alive:
                     time.sleep(hello_interval_s)
                     if self._alive:
-                        self._hello()
+                        try:
+                            self._hello()
+                        except Exception:
+                            # the keep-alive thread must survive a flaky
+                            # queue link — the next interval retries anyway
+                            log.warning("re-hello to %s failed",
+                                        self.queue_address, exc_info=True)
             threading.Thread(target=_rehello, daemon=True,
                              name="verifier-hello").start()
 
     def _hello(self) -> None:
-        self.network_service.send(
-            TopicSession(TOPIC_VERIFIER_REQUESTS),
-            serialize(WorkerHello(self.network_service.my_address)),
-            self.queue_address)
+        retry.retry_call(
+            lambda: self.network_service.send(
+                TopicSession(TOPIC_VERIFIER_REQUESTS),
+                serialize(WorkerHello(self.network_service.my_address)),
+                self.queue_address),
+            site="oop.hello",
+            policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5, max_attempts=4),
+            retry_on=(OSError, ConnectionError, LookupError))
 
     @property
     def batcher(self):
@@ -380,6 +421,13 @@ class VerifierWorker:
     def _reply(self, req: VerificationRequest, error: str | None) -> None:
         if not self._alive:
             return   # killed mid-verify: the node requeues our outstanding work
+        # a "drop" rule here models a worker crashing BETWEEN finishing the
+        # verify and sending the response — the node must redeliver
+        if fault_point(
+                "oop.reply",
+                detail=f"{self.network_service.my_address}"
+                       f"->{req.response_address}") == DROP:
+            return
         with self._count_lock:   # replies run on the completion pool's threads
             self.verified_count += 1
         self.network_service.send(

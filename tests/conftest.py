@@ -43,3 +43,30 @@ try:
               file=sys.stderr)
 except (OSError, subprocess.TimeoutExpired):
     pass  # no toolchain: fallbacks cover the formats
+
+# Chaos reproducibility: when a fault-injection test fails, print the seed
+# that drove its injector so the red run reproduces verbatim
+# (CORDA_TPU_FAULT_SEED=<seed> pytest <nodeid>). The hookwrapper sees the
+# report AFTER the test body ran but while the injector may still be armed
+# (inject() disarms in its finally, which runs inside the call phase — so
+# the test itself stashes the seed on the item via the chaos_seed fixture
+# or we read the param).
+import pytest
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != "call" or not report.failed:
+        return
+    if item.get_closest_marker("chaos") is None:
+        return
+    from corda_tpu.utils import faults as _faults
+    inj = _faults.active()
+    seed = inj.seed if inj is not None else item.funcargs.get("seed")
+    if seed is not None:
+        report.sections.append((
+            "chaos seed",
+            f"fault seed {seed} — reproduce with "
+            f"CORDA_TPU_FAULT_SEED={seed} pytest {item.nodeid!r}"))

@@ -1,0 +1,186 @@
+"""Crash-consistent checkpointing chaos tests (satellite of the chaos
+harness): kill a flow between sending its response and removing its
+checkpoint, restart the node on the SAME checkpoint storage, and prove
+the replay is idempotent — the flow completes again without re-sending
+anything, and the stale checkpoint is cleaned up.
+
+The crash window is the ``smm.checkpoint_remove`` fault point in
+StateMachineManager._finalize: a "drop" rule skips the removal, which is
+exactly the artifact a crash at that instant leaves on disk. Checkpoints
+are written at suspension points, so the flows here park on a trailing
+flow-timer ``Sleep`` AFTER their sends — that park persists the send in
+the response log, and the timer re-arms deterministically on replay
+(test_flow_timers' mid-sleep-restart semantics).
+"""
+import pytest
+
+from corda_tpu.core.serialization import deserialize
+from corda_tpu.flows.api import (FlowLogic, Receive, Send, SendAndReceive,
+                                 Sleep, initiated_by, initiating_flow)
+from corda_tpu.node.checkpoints import FileCheckpointStorage, KvCheckpointStorage
+from corda_tpu.node.statemachine import SessionData, SessionInit
+from corda_tpu.testing import MockNetwork
+from corda_tpu.testing.faults import FaultRule, inject
+
+pytestmark = pytest.mark.chaos
+
+SEEDS = [7, 101, 9001]
+
+
+@initiating_flow
+class AskFlow(FlowLogic):
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        answer = yield SendAndReceive(self.peer, "question", str)
+        return answer.unwrap(lambda d: d)
+
+
+@initiated_by(AskFlow)
+class AnswerThenPauseFlow(FlowLogic):
+    """Responds immediately, then parks on a housekeeping Sleep — the park
+    writes the checkpoint whose response log already holds the answer
+    send, i.e. the artifact a crash-before-remove leaves behind."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        _ = yield Receive(self.peer, str)
+        yield Send(self.peer, "answer")
+        yield Sleep(1.0)
+        return "done"
+
+
+@initiating_flow
+class AskThenPauseFlow(FlowLogic):
+    """Initiator variant: the answer is in the log before the final park."""
+
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        answer = yield SendAndReceive(self.peer, "question", str)
+        yield Sleep(1.0)
+        return answer.unwrap(lambda d: d)
+
+
+@initiated_by(AskThenPauseFlow)
+class AnswerNowFlow(FlowLogic):
+    def __init__(self, peer):
+        self.peer = peer
+
+    def call(self):
+        _ = yield Receive(self.peer, str)
+        yield Send(self.peer, "answer")
+        return "done"
+
+
+def count_session_traffic(bus, recipient):
+    """How many session payload-bearing messages (SessionInit/SessionData)
+    were ever sent to `recipient` — the double-send detector for the
+    idempotent-replay assertions."""
+    n = 0
+    for transfer in bus.sent_log:
+        if transfer.recipient != recipient:
+            continue
+        try:
+            if isinstance(deserialize(transfer.message.data),
+                          (SessionInit, SessionData)):
+                n += 1
+        except Exception:
+            pass
+    return n
+
+
+def make_storage(kind, tmp_path):
+    if kind == "file":
+        return FileCheckpointStorage(str(tmp_path / "ckpts"))
+    return KvCheckpointStorage(str(tmp_path / "ckpts.kv"), use_native=False)
+
+
+@pytest.mark.parametrize("kind", ["file", "kv"])
+@pytest.mark.parametrize("seed", SEEDS)
+def test_responder_replay_after_crash_between_send_and_remove(
+        tmp_path, kind, seed):
+    """Bob's responder sends its answer, then 'crashes' in _finalize before
+    remove_checkpoint. On restart the stale checkpoint replays: the flow
+    must finish WITHOUT re-sending the answer (Alice sees exactly the same
+    session traffic before and after) and the checkpoint must be removed."""
+    network = MockNetwork()
+    a = network.create_node("O=Alice, L=London, C=GB")
+    b = network.create_node(
+        "O=Bob, L=Paris, C=FR",
+        checkpoint_storage=make_storage(kind, tmp_path))
+    network.start_nodes()
+
+    fsm = a.start_flow(AskFlow(b.party))
+    network.run_network()
+    # Alice has her answer; Bob is parked on his Sleep with one checkpoint
+    assert fsm.result_future.result(timeout=1) == "answer"
+    assert len(b.smm.checkpoints.get_all_checkpoints()) == 1
+
+    # Bob's timer fires and his flow completes — but the injected drop
+    # skips remove_checkpoint: the crash window between send and remove
+    with inject(FaultRule("smm.checkpoint_remove", "drop", count=1),
+                seed=seed) as inj:
+        network.advance_clock(2.0)
+    assert inj.fired("smm.checkpoint_remove") == 1
+    assert b.smm.flows == {}
+    assert len(b.smm.checkpoints.get_all_checkpoints()) == 1   # the artifact
+
+    alice_addr = str(a.party.name)
+    sends_before = count_session_traffic(network.bus, alice_addr)
+
+    # restart Bob on the same storage: the replay consumes the response
+    # log (the answer send included — no wire IO) and re-parks on Sleep
+    b2 = b.restart()
+    b2.start()
+    assert len(b2.smm.flows) == 1
+    network.advance_clock(2.0)     # the re-armed timer fires; flow completes
+
+    assert b2.smm.flows == {}
+    assert b2.smm.checkpoints.get_all_checkpoints() == []
+    # idempotent: no duplicate answer (or any session message) hit Alice
+    assert count_session_traffic(network.bus, alice_addr) == sends_before
+    assert a.smm.flows == {}
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_initiator_replay_after_crash_before_remove(tmp_path, seed):
+    """Same crash window on the INITIATOR: Alice already received her
+    answer (it is in the checkpointed response log); her restart must
+    replay to completion without opening a duplicate session to Bob."""
+    network = MockNetwork()
+    a = network.create_node(
+        "O=Alice, L=London, C=GB",
+        checkpoint_storage=make_storage("file", tmp_path))
+    b = network.create_node("O=Bob, L=Paris, C=FR")
+    network.start_nodes()
+
+    fsm = a.start_flow(AskThenPauseFlow(b.party))
+    network.run_network()
+    assert not fsm.result_future.done()      # parked on the trailing Sleep
+    assert len(a.smm.checkpoints.get_all_checkpoints()) == 1
+
+    with inject(FaultRule("smm.checkpoint_remove", "drop", count=1),
+                seed=seed) as inj:
+        network.advance_clock(2.0)
+    assert inj.fired("smm.checkpoint_remove") == 1
+    assert fsm.result_future.result(timeout=1) == "answer"
+    assert len(a.smm.checkpoints.get_all_checkpoints()) == 1   # the artifact
+
+    bob_addr = str(b.party.name)
+    traffic_before = count_session_traffic(network.bus, bob_addr)
+
+    a2 = a.restart()
+    a2.start()
+    assert len(a2.smm.flows) == 1
+    network.advance_clock(2.0)
+
+    assert a2.smm.flows == {}
+    assert a2.smm.checkpoints.get_all_checkpoints() == []
+    # the replayed initiator never re-sent its question to Bob
+    assert count_session_traffic(network.bus, bob_addr) == traffic_before
+    assert b.smm.flows == {}

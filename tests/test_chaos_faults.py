@@ -1,0 +1,293 @@
+"""Chaos harness unit tests: the fault injector's scheduling semantics,
+the retry module's backoff/deadline behavior, and the fail-stop seams
+they gate (TCP startup, KvStore flush).
+
+Everything here must be exactly reproducible from a seed — that is the
+whole point of the harness (docs/ROBUSTNESS.md).
+"""
+import threading
+import time
+
+import pytest
+
+from corda_tpu.testing.faults import (DROP, DUPLICATE, FaultError,
+                                      FaultInjector, FaultRule, active,
+                                      arm, disarm, fault_point, inject)
+from corda_tpu.utils import retry
+
+pytestmark = pytest.mark.chaos
+
+
+# -- scheduling predicates ---------------------------------------------------
+
+def test_disarmed_fault_point_is_inert():
+    assert active() is None
+    assert fault_point("tcp.send", detail="a->b") is None
+
+
+def test_count_limits_fires():
+    with inject(FaultRule("net.send", "drop", count=2), seed=1) as inj:
+        outcomes = [fault_point("net.send", detail="a->b") for _ in range(5)]
+    assert outcomes == [DROP, DROP, None, None, None]
+    assert inj.fired("net.send") == 2
+
+
+def test_after_skips_leading_hits():
+    with inject(FaultRule("x", "drop", after=2), seed=1) as inj:
+        outcomes = [fault_point("x") for _ in range(4)]
+    assert outcomes == [None, None, DROP, DROP]
+    assert inj.fired("x") == 2
+
+
+def test_every_selects_kth_hit():
+    with inject(FaultRule("x", "drop", every=3), seed=1):
+        outcomes = [fault_point("x") for _ in range(7)]
+    # fires on eligible hits 1, 4, 7 (every 3rd, starting at the first)
+    assert outcomes == [DROP, None, None, DROP, None, None, DROP]
+
+
+def test_detail_fnmatch_targets_one_peer():
+    """Pattern rules on `detail` are how a test partitions one node."""
+    rule = FaultRule("net.send", "drop", detail="alice->*")
+    with inject(rule, seed=1) as inj:
+        assert fault_point("net.send", detail="alice->bob") == DROP
+        assert fault_point("net.send", detail="bob->alice") is None
+        assert fault_point("net.send", detail="alice->carol") == DROP
+    assert inj.fired("net.send") == 2
+
+
+def test_point_fnmatch():
+    with inject(FaultRule("tcp.*", "drop"), seed=1):
+        assert fault_point("tcp.send") == DROP
+        assert fault_point("tcp.connect") == DROP
+        assert fault_point("net.send") is None
+
+
+def test_raise_action_throws_connectionerror_subclass():
+    """FaultError must be a ConnectionError so transport except-clauses
+    catch injected faults exactly as they catch real socket failures."""
+    with inject(FaultRule("oop.deliver")):  # action defaults to "raise"
+        with pytest.raises(FaultError):
+            fault_point("oop.deliver", detail="->w1")
+    assert issubclass(FaultError, ConnectionError)
+    assert issubclass(FaultError, OSError)
+
+
+def test_raise_custom_exception_type():
+    class Boom(RuntimeError):
+        pass
+
+    with inject(FaultRule("x", "raise", exc=Boom)):
+        with pytest.raises(Boom):
+            fault_point("x")
+
+
+def test_duplicate_is_returned_to_call_site():
+    with inject(FaultRule("net.send", "duplicate", count=1)):
+        assert fault_point("net.send", detail="a->b") == DUPLICATE
+        assert fault_point("net.send", detail="a->b") is None
+
+
+def test_delay_action_sleeps_and_composes():
+    """A delay rule slows the hit, then the scan continues — so it can
+    stack with a drop rule on the same point."""
+    with inject(FaultRule("x", "delay", delay_s=0.05),
+                FaultRule("x", "drop")):
+        t0 = time.monotonic()
+        assert fault_point("x") == DROP
+        assert time.monotonic() - t0 >= 0.05
+
+
+def test_probability_deterministic_per_seed():
+    def run(seed):
+        with inject(FaultRule("x", "drop", probability=0.5), seed=seed):
+            return [fault_point("x") for _ in range(32)]
+
+    a, b = run(42), run(42)
+    assert a == b                       # same seed ⇒ identical schedule
+    assert run(43) != a                 # 1-in-2^32 flake odds; fine
+    assert 0 < a.count(DROP) < 32       # the coin actually flips
+
+
+def test_probability_independent_of_other_rules():
+    """Per-rule RNGs: arming an extra rule must not shift which hits a
+    probabilistic rule fires on."""
+    def run(extra):
+        rules = [FaultRule("x", "drop", probability=0.5)]
+        if extra:
+            rules.append(FaultRule("unrelated", "drop", probability=0.3))
+        with inject(*rules, seed=7):
+            # interleave hits on the unrelated point
+            out = []
+            for _ in range(16):
+                fault_point("unrelated")
+                out.append(fault_point("x"))
+            return out
+
+    assert run(extra=False) == run(extra=True)
+
+
+def test_env_seed_pickup(monkeypatch):
+    monkeypatch.setenv("CORDA_TPU_FAULT_SEED", "1234")
+    assert FaultInjector().seed == 1234
+    assert FaultInjector(seed=9).seed == 9   # explicit wins
+
+
+def test_arm_disarm_and_active():
+    inj = FaultInjector(seed=5)
+    inj.add(FaultRule("x", "drop"))
+    arm(inj)
+    try:
+        assert active() is inj
+        assert fault_point("x") == DROP
+    finally:
+        disarm()
+    assert active() is None
+    assert fault_point("x") is None
+
+
+def test_concurrent_hits_all_accounted():
+    """The injector is hit from transport/dispatcher threads — counts must
+    stay exact under concurrency."""
+    with inject(FaultRule("x", "drop", count=50), seed=3) as inj:
+        def worker():
+            for _ in range(25):
+                fault_point("x")
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert inj.fired("x") == 50
+    assert inj.rules[0].matches == 100
+
+
+# -- retry/backoff -----------------------------------------------------------
+
+def test_delays_bounded_and_jittered():
+    policy = retry.RetryPolicy(base_s=0.05, cap_s=0.4)
+    seq = [next(d) for d in [retry.delays(policy, seed=11)] for _ in range(20)]
+    assert all(policy.base_s <= s <= policy.cap_s for s in seq)
+    assert len(set(seq)) > 1            # jittered, not a fixed ladder
+    # deterministic for a given seed
+    d2 = retry.delays(policy, seed=11)
+    assert [next(d2) for _ in range(20)] == seq
+
+
+def test_retry_call_recovers_and_meters():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    before = retry.snapshot().get("Retry.Attempts.chaos_ut", {}).get("count", 0)
+    out = retry.retry_call(flaky, site="chaos_ut",
+                           policy=retry.RetryPolicy(base_s=0.001, cap_s=0.002),
+                           retry_on=(ConnectionError,), sleep=lambda s: None)
+    assert out == "ok" and calls["n"] == 3
+    snap = retry.snapshot()
+    assert snap["Retry.Attempts.chaos_ut"]["count"] - before == 3
+    assert snap["Retry.Attempts"]["count"] >= 3
+
+
+def test_retry_call_gives_up_after_max_attempts():
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        retry.retry_call(always, site="chaos_ut_giveup",
+                         policy=retry.RetryPolicy(base_s=0.001, cap_s=0.002,
+                                                  max_attempts=3),
+                         sleep=lambda s: None)
+    snap = retry.snapshot()
+    assert snap["Retry.Attempts.chaos_ut_giveup"]["count"] == 3
+    assert snap["Retry.GiveUps.chaos_ut_giveup"]["count"] == 1
+
+
+def test_retry_call_respects_deadline_budget():
+    """The deadline breaks the loop when the *projected* sleep would blow
+    the budget — no attempt cap needed to stop it."""
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    def sleep(s):
+        now[0] += s
+
+    attempts = {"n": 0}
+
+    def always():
+        attempts["n"] += 1
+        raise TimeoutError("slow")
+
+    with pytest.raises(TimeoutError):
+        retry.retry_call(always, site="chaos_ut_deadline",
+                         policy=retry.RetryPolicy(base_s=0.2, cap_s=0.3,
+                                                  max_attempts=100,
+                                                  deadline_s=0.5),
+                         seed=1, sleep=sleep, clock=clock)
+    assert attempts["n"] < 100          # deadline, not the cap, stopped it
+    assert now[0] <= 0.5                # never slept past the budget
+
+
+def test_retry_does_not_catch_unlisted_exceptions():
+    def typo():
+        raise ValueError("logic bug")
+
+    with pytest.raises(ValueError):
+        retry.retry_call(typo, site="chaos_ut_unlisted",
+                         retry_on=(ConnectionError,), sleep=lambda s: None)
+    # exactly one attempt: logic bugs must not be retried
+    assert retry.snapshot()["Retry.Attempts.chaos_ut_unlisted"]["count"] == 1
+
+
+# -- fail-stop seams ---------------------------------------------------------
+
+def test_tcp_startup_bind_failure_raises():
+    """Satellite: a failed bind must raise MessagingStartupError from the
+    constructor, not park the node on a dead event loop."""
+    import socket
+
+    from corda_tpu.network.tcp import MessagingStartupError, TcpMessagingService
+
+    blocker = socket.socket()
+    try:
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        with pytest.raises(MessagingStartupError, match="failed to bind"):
+            TcpMessagingService("dup", "127.0.0.1", port,
+                                resolve_address=lambda name: None)
+    finally:
+        blocker.close()
+
+
+def test_kvstore_flush_fault_fail_stops(tmp_path):
+    """An injected SyncFailure at the kvstore.flush seam must fail-stop the
+    store (no silent acceptance of unsynced writes) and leave previously
+    committed data recoverable on reopen."""
+    from corda_tpu.storage.kvstore import KvStore, SyncFailure
+
+    path = str(tmp_path / "kv")
+    kv = KvStore(path, use_native=False)
+    kv[b"committed"] = b"v1"
+
+    with inject(FaultRule("kvstore.flush", "raise", exc=SyncFailure,
+                          count=1)):
+        with pytest.raises(SyncFailure):
+            kv[b"doomed"] = b"v2"
+        # fail-stop: the store refuses further writes after a sync failure
+        with pytest.raises(SyncFailure):
+            kv[b"after"] = b"v3"
+    kv.close()
+
+    kv2 = KvStore(path, use_native=False)
+    try:
+        assert kv2[b"committed"] == b"v1"
+        assert b"doomed" not in kv2
+    finally:
+        kv2.close()

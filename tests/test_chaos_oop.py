@@ -1,0 +1,135 @@
+"""Out-of-process verifier chaos tests: crashed and lossy workers.
+
+The broker invariant under test: EVERY submitted verification future
+resolves — a worker crash costs at most one redelivery, never a lost or
+hung future. Faults ride the seeded injector at the ``oop.deliver`` /
+``oop.reply`` / ``net.send`` seams (docs/ROBUSTNESS.md).
+"""
+import time
+
+import pytest
+
+from corda_tpu.network.inmemory import InMemoryMessagingNetwork
+from corda_tpu.testing.faults import FaultRule, inject
+from corda_tpu.utils import retry
+from corda_tpu.verifier.out_of_process import (
+    OutOfProcessTransactionVerifierService, VerifierWorker)
+
+from test_oop_verifier import make_ltx
+
+pytestmark = pytest.mark.chaos
+
+SEEDS = [7, 101, 9001]
+
+
+@pytest.fixture
+def bus():
+    return InMemoryMessagingNetwork()
+
+
+def test_send_failure_detaches_worker_immediately(bus):
+    """A delivery send that RAISES is a live crash signal: the queue must
+    detach the worker and redeal its share at once — one redelivery, not a
+    redelivery-timeout wait (and with no timeout configured at all)."""
+    node = bus.create_node("node")
+    svc = OutOfProcessTransactionVerifierService(node)
+    w1 = VerifierWorker(bus.create_node("w1"), "node")
+    w2 = VerifierWorker(bus.create_node("w2"), "node")
+    bus.run_network()
+    assert svc.queue.worker_count == 2
+
+    with inject(FaultRule("oop.deliver", "raise", detail="->w1")):
+        futures = [svc.verify(make_ltx(i)) for i in range(10)]
+        bus.run_network()
+        for f in futures:
+            assert f.result(timeout=1) is None
+
+    assert svc.queue.worker_count == 1      # w1 detached on first failure
+    assert w1.verified_count == 0
+    assert w2.verified_count == 10
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_lost_delivery_recovered_by_redelivery_timeout(bus, seed):
+    """A delivery that vanishes in flight (worker never sees it) leaves no
+    crash signal — the redelivery-timeout scan is what recovers it."""
+    node = bus.create_node("node")
+    # timeout set on the queue directly: the scan is driven by hand below,
+    # so the background scanner thread cannot race the manually pumped bus
+    svc = OutOfProcessTransactionVerifierService(node)
+    svc.queue.redelivery_timeout_s = 0.05
+    try:
+        VerifierWorker(bus.create_node("w1"), "node")
+        w2 = VerifierWorker(bus.create_node("w2"), "node")
+        bus.run_network()
+
+        with inject(FaultRule("oop.deliver", "drop", detail="->w1",
+                              count=1), seed=seed) as inj:
+            fut = svc.verify(make_ltx(1))
+            bus.run_network()
+            if not fut.done():
+                # the drop hit w1's deal: silence until the scan fires
+                assert inj.fired("oop.deliver") == 1
+                time.sleep(0.12)
+                svc.queue.requeue_overdue()
+                bus.run_network()
+            assert fut.result(timeout=1) is None
+        assert w2.verified_count >= svc.queue.worker_count - 1
+    finally:
+        svc.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_worker_crash_mid_batch_completes_every_future(bus, seed):
+    """Worker crashes BETWEEN verifying and replying (all its replies are
+    dropped): after the redelivery timeout its whole dealt share requeues
+    onto the survivor and every one of the 20 futures resolves."""
+    node = bus.create_node("node")
+    svc = OutOfProcessTransactionVerifierService(node)
+    svc.queue.redelivery_timeout_s = 0.05
+    try:
+        w1 = VerifierWorker(bus.create_node("w1"), "node")
+        w2 = VerifierWorker(bus.create_node("w2"), "node")
+        bus.run_network()
+
+        with inject(FaultRule("oop.reply", "drop", detail="w1->*"),
+                    seed=seed) as inj:
+            futures = [svc.verify(make_ltx(i)) for i in range(20)]
+            bus.run_network()
+            # w1's ten replies all vanished (the drop fires before the
+            # sent-reply counter, so its count stays 0 — a true crash)
+            assert w1.verified_count == 0
+            assert inj.fired("oop.reply") == 10
+            assert sum(f.done() for f in futures) == 10
+            w1.stop(announce=False)   # and now it is really gone
+
+            time.sleep(0.12)
+            svc.queue.requeue_overdue()
+            bus.run_network()
+            for f in futures:
+                assert f.result(timeout=1) is None
+
+        assert w2.verified_count == 20
+        assert svc.queue.worker_count == 1
+        assert svc.metrics.snapshot()["Verification.Success"]["count"] == 20
+    finally:
+        svc.shutdown()
+
+
+def test_worker_hello_retries_through_transient_send_failure(bus):
+    """The worker's attach handshake rides retry_call: two injected send
+    failures must not keep it off the queue."""
+    node = bus.create_node("node")
+    svc = OutOfProcessTransactionVerifierService(node)
+    before = retry.snapshot().get("Retry.Attempts.oop.hello",
+                                  {}).get("count", 0)
+    with inject(FaultRule("net.send", "raise", detail="w1->node", count=2)):
+        worker = VerifierWorker(bus.create_node("w1"), "node")
+        bus.run_network()
+    assert svc.queue.worker_count == 1
+    fut = svc.verify(make_ltx(1))
+    bus.run_network()
+    assert fut.result(timeout=1) is None
+    assert worker.verified_count == 1
+    snap = retry.snapshot()
+    assert snap["Retry.Attempts.oop.hello"]["count"] - before == 3

@@ -1,0 +1,171 @@
+"""Raft chaos tests: partitions, leader kills, and message-drop storms
+driven by the seeded fault injector over the deterministic bus.
+
+The property under test is the notary's uniqueness SAFETY: across any
+partition/re-election interleaving, conflicting put_all commands commit
+at most once, and every replica's DistributedImmutableMap converges to
+the same winner. Each scenario runs under several seeds — the injector
+guarantees a given seed replays the identical fault schedule.
+"""
+import pytest
+
+from corda_tpu.consensus.raft import FOLLOWER, LEADER, RaftNode
+from corda_tpu.consensus.raft_uniqueness import DistributedImmutableMap
+from corda_tpu.core.contracts.structures import StateRef
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.network.inmemory import InMemoryMessagingNetwork
+from corda_tpu.testing.faults import FaultRule, inject
+
+pytestmark = pytest.mark.chaos
+
+SEEDS = [7, 101, 9001]
+
+
+def make_map_cluster(n=3):
+    """RaftNode cluster where each replica applies into its own
+    DistributedImmutableMap (the raft-notary state machine)."""
+    bus = InMemoryMessagingNetwork()
+    names = [f"raft{i}" for i in range(n)]
+    maps = [DistributedImmutableMap() for _ in range(n)]
+    nodes = [RaftNode(name, list(names), bus.create_node(name),
+                      maps[i].apply, seed=i)
+             for i, name in enumerate(names)]
+    return bus, nodes, maps
+
+
+def pump(bus, nodes, ticks=10):
+    for _ in range(ticks):
+        for node in nodes:
+            node.tick()
+        bus.run_network()
+
+
+def run_until_leader(bus, nodes, exclude=(), max_ticks=400):
+    live = [n for n in nodes if n not in exclude]
+    for _ in range(max_ticks):
+        pump(bus, nodes, 1)
+        leaders = [n for n in live if n.role == LEADER]
+        if len(leaders) == 1:
+            pump(bus, nodes, 5)   # settle follower state
+            final = [n for n in live if n.role == LEADER]
+            if len(final) == 1:
+                return final[0]
+    raise AssertionError("no leader elected")
+
+
+def partition_rules(name):
+    """Drop every bus message to and from `name` — a full partition."""
+    return (FaultRule("net.send", "drop", detail=f"{name}->*"),
+            FaultRule("net.send", "drop", detail=f"*->{name}"))
+
+
+def put_all(node, tx_id, refs, timeout_ticks, bus, nodes):
+    """Submit a put_all and pump until its future resolves (or give up)."""
+    fut = node.submit(("put_all", [tx_id, refs, "chaos-test"]))
+    for _ in range(timeout_ticks):
+        if fut.done():
+            break
+        pump(bus, nodes, 1)
+    return fut
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_uniqueness_safety_across_partition(seed):
+    """Partition the leader away; the majority elects a new leader and
+    commits a spend. The old leader's conflicting submission must NEVER
+    commit — after the heal every replica agrees on the one winner and a
+    re-notarisation attempt reports the conflict."""
+    bus, nodes, maps = make_map_cluster(3)
+    old_leader = run_until_leader(bus, nodes)
+    ref = StateRef(SecureHash.sha256(b"contended-state"), 0)
+
+    with inject(*partition_rules(old_leader.node_id), seed=seed):
+        # the doomed side: the isolated old leader accepts a client
+        # submission it can never replicate to a majority
+        doomed = old_leader.submit(("put_all", [["tx-doomed"], [ref],
+                                               "chaos-test"]))
+        new_leader = run_until_leader(bus, nodes, exclude=(old_leader,))
+        assert new_leader is not old_leader
+        # the winning side: the majority commits the conflicting spend
+        won = put_all(new_leader, ["tx-winner"], [ref], 200, bus, nodes)
+        assert won.result(timeout=1) == {"committed": True, "conflicts": {}}
+
+    # heal: the old leader rejoins, observes the higher term, steps down,
+    # and its uncommitted entry is overwritten by the winner's log
+    pump(bus, nodes, 60)
+    assert old_leader.role == FOLLOWER
+    # SAFETY: the doomed submission never reported success
+    assert not (doomed.done() and not doomed.exception()
+                and doomed.result().get("committed"))
+    # every replica converged on the same single owner for the ref
+    for m in maps:
+        assert len(m) == 1
+    key = next(iter(maps[0]._map))
+    assert all(m._map[key] == maps[0]._map[key] for m in maps)
+
+    # a retry of the losing tx now reports the conflict on every path
+    rerun = put_all(nodes[0], ["tx-doomed"], [ref], 200, bus, nodes)
+    out = rerun.result(timeout=1)
+    assert out["committed"] is False and out["conflicts"]
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_progress_after_leader_kill(seed):
+    """Kill the leader outright (permanent full partition): the survivors
+    re-elect and keep committing — liveness under a single node failure."""
+    bus, nodes, maps = make_map_cluster(3)
+    leader = run_until_leader(bus, nodes)
+
+    with inject(*partition_rules(leader.node_id), seed=seed):
+        successor = run_until_leader(bus, nodes, exclude=(leader,))
+        refs = [StateRef(SecureHash.sha256(b"k%d" % i), 0) for i in range(3)]
+        for i, ref in enumerate(refs):
+            fut = put_all(successor, [f"tx{i}"], [ref], 200, bus, nodes)
+            assert fut.result(timeout=1)["committed"] is True
+        # commit-index propagation rides the next heartbeats; settle, then
+        # both survivors must have applied all three commits
+        pump(bus, nodes, 20)
+        live_maps = [maps[i] for i, n in enumerate(nodes) if n is not leader]
+        assert all(len(m) == 3 for m in live_maps)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_commits_survive_append_drop_storm(seed):
+    """30% of AppendEntries traffic dropped (seeded): the leader's tick
+    resend loop must still drive every entry to commitment on every
+    replica. Client submissions retry on leadership churn, so an entry
+    may apply more than once — the invariant is replica AGREEMENT plus
+    all entries present, which is exactly what the idempotent put_all
+    command set relies on upstream."""
+    applied = [[], [], []]
+    bus = InMemoryMessagingNetwork()
+    names = [f"raft{i}" for i in range(3)]
+    nodes = [RaftNode(name, list(names), bus.create_node(name),
+                      (lambda s: (lambda e: (s.append(e), len(s))[1]))(applied[i]),
+                      seed=i)
+             for i, name in enumerate(names)]
+    run_until_leader(bus, nodes)
+
+    with inject(FaultRule("raft.append", "drop", probability=0.3),
+                seed=seed):
+        for i in range(5):
+            entry = f"entry-{i}"
+            for _attempt in range(40):
+                leader = next((n for n in nodes if n.role == LEADER), None)
+                if leader is None:
+                    pump(bus, nodes, 10)
+                    continue
+                fut = leader.submit(entry)
+                for _ in range(60):
+                    pump(bus, nodes, 1)
+                    if fut.done():
+                        break
+                if fut.done() and not fut.exception():
+                    break
+            else:
+                raise AssertionError(f"{entry} never committed under storm")
+        pump(bus, nodes, 80)   # let stragglers catch up inside the storm
+
+    assert applied[0] == applied[1] == applied[2]
+    for i in range(5):
+        assert f"entry-{i}" in applied[0]

@@ -122,3 +122,48 @@ def test_readyz_tracks_batcher_dispatcher(web):
     finally:
         alice.services.verifier_service = None
         svc.shutdown()
+
+
+def test_retry_and_breaker_metric_families(web):
+    """Robustness counters ride both metric surfaces: the retry module's
+    process-wide registry is merged into /api/metrics + /metrics, and a
+    batcher wired to the node registry contributes its breaker families
+    (state gauges per scheme, trip meter) even before anything trips."""
+    from corda_tpu.utils import retry
+    from corda_tpu.verifier.batcher import SignatureBatcher
+    from corda_tpu.verifier.service import TpuTransactionVerifierService
+    network, alice, server = web
+    svc = TpuTransactionVerifierService(
+        workers=1,
+        batcher=SignatureBatcher(use_device=False,
+                                 metrics=alice.services.monitoring))
+    alice.services.verifier_service = svc
+    try:
+        # exercise one retry site so the per-site meter exists too
+        retry.retry_call(lambda: None, site="webtest",
+                         policy=retry.RetryPolicy(max_attempts=1))
+
+        metrics = _get(server, "/api/metrics")
+        assert "Retry.Attempts" in metrics          # always-present family
+        assert "Retry.GiveUps" in metrics
+        assert metrics["Retry.Attempts.webtest"]["count"] >= 1
+        for scheme in ("ed25519", "secp256k1", "secp256r1"):
+            assert metrics[f"Breaker.State.{scheme}"]["value"] == 0
+        assert metrics["Breaker.Trips"]["count"] == 0
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "corda_tpu_retry_attempts" in text
+        assert "corda_tpu_breaker_state_ed25519" in text
+        assert "corda_tpu_breaker_trips" in text
+
+        # a trip moves the gauge and meter on the same surfaces
+        for _ in range(3):
+            svc.batcher._breakers["secp256r1"].record_failure()
+        metrics = _get(server, "/api/metrics")
+        assert metrics["Breaker.State.secp256r1"]["value"] == 1
+        assert metrics["Breaker.Trips"]["count"] == 1
+    finally:
+        alice.services.verifier_service = None
+        svc.shutdown()
