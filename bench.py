@@ -197,17 +197,27 @@ def _ed_triples(items):
             for pub, sig, msg in items]
 
 
-def _service_rate_for(batcher, triples) -> float:
-    """Median continuous-stream rate over SERVICE_RUNS runs (all reps
-    queued up front so batch N+1's host prep overlaps batch N's device
-    round-trip — the service's steady-state shape).  The warm pass queues
-    the SAME depth as the timed loop so every bucket size the drain will
-    produce compiles HERE (fresh bucket kernels cost hundreds of seconds
-    through the tunnel, persistent-cached afterwards) — a shallower warm
-    left the timed loop hitting uncompiled remainder buckets."""
+def _service_warm(batcher, triples) -> None:
+    """Warm one stream at the SAME depth as the timed loop, plus every
+    bucket-ladder rung the continuous planner can cut from it, so all
+    shapes the timed loop will see compile HERE (fresh bucket kernels cost
+    hundreds of seconds through the tunnel, persistent-cached afterwards).
+    mark_warm() after all warms makes any later compile a counted
+    regression (post_warmup_compiles)."""
     warm = [batcher.submit_group(triples) for _ in range(REPS)]
     for wf in warm:
         assert all(wf.result(timeout=3000))
+    for rung in batcher._default_ladder:
+        if rung >= len(triples):
+            break
+        assert all(batcher.submit_group(triples[:rung]).result(timeout=3000))
+
+
+def _service_rate_for(batcher, triples) -> float:
+    """Median continuous-stream rate over SERVICE_RUNS runs (all reps
+    queued up front so batch N+1's host prep overlaps batch N's device
+    round-trip — the service's steady-state shape). Streams must be warmed
+    via _service_warm first."""
     rates = []
     for _ in range(SERVICE_RUNS):
         t0 = time.perf_counter()
@@ -218,14 +228,21 @@ def _service_rate_for(batcher, triples) -> float:
     return statistics.median(rates)
 
 
-def service_metrics(k1_items, ed_items, r1_items):
+def _pctl(sorted_samples, q: float) -> float:
+    """Nearest-rank percentile of an already-sorted sample list."""
+    idx = min(len(sorted_samples) - 1, int(q * len(sorted_samples)))
+    return sorted_samples[idx]
+
+
+def service_metrics(k1_items, ed_items, r1_items) -> dict:
     """Service-path numbers through the SignatureBatcher seam (host prep +
     device kernel + future resolution — what a node actually gets): k1,
-    ed25519, r1, and a mixed-scheme stream; p50 @ batch=1 and @ batch=1k;
-    the prep-overlap high-water mark (how many scheme preps actually ran
-    concurrently on the prep pool)."""
+    ed25519, r1, and a mixed-scheme stream; p50 @ batch=1 and p50/p90/p99
+    @ batch=1k (interactive class); the prep-overlap high-water mark; and
+    the post-warmup compile count (zero when the bucket ladder kept the
+    jit cache hot through the whole timed phase)."""
     from corda_tpu.core.crypto.schemes import ECDSA_SECP256R1_SHA256
-    from corda_tpu.observability import stage_percentiles
+    from corda_tpu.observability import get_profiler, stage_percentiles
     from corda_tpu.utils.metrics import MetricRegistry
     from corda_tpu.verifier.batcher import SignatureBatcher
 
@@ -241,10 +258,22 @@ def service_metrics(k1_items, ed_items, r1_items):
              + r1_full[: max(1, n - 2 * int(0.45 * n))])
     registry = MetricRegistry()
     # the kernel flight recorder's gauges/histograms ride the same snapshot
-    from corda_tpu.observability import get_profiler
-    get_profiler().publish(registry)
+    prof = get_profiler()
+    prof.publish(registry)
     batcher = SignatureBatcher(metrics=registry)
+    sub = k1_triples[:1024]
     try:
+        # warm EVERY stream (and the interactive 1k bucket + a single
+        # submit) before the warmup boundary: after mark_warm() the timed
+        # phase must run entirely on the hot jit cache — any compile past
+        # this point counts in post_warmup_compiles
+        for stream in (k1_triples, ed_triples, r1_full, mixed):
+            _service_warm(batcher, stream)
+        assert all(batcher.submit_group(
+            sub, latency_class="interactive").result(timeout=900))
+        key0, der0, msg0 = k1_triples[0]
+        assert batcher.submit(key0, der0, msg0).result(timeout=900)
+        prof.mark_warm()
         k1_rate = _service_rate_for(batcher, k1_triples)
         ed_rate = _service_rate_for(batcher, ed_triples)
         r1_rate = _service_rate_for(batcher, r1_full)
@@ -258,18 +287,21 @@ def service_metrics(k1_items, ed_items, r1_items):
         p50_ms = sorted(latencies)[len(latencies) // 2] * 1000.0
         # mid-size-batch latency (VERDICT r3 weak #5 / r4 #7): the band
         # between the host crossover (192) and dispatch-floor amortization
-        # (~8k) pays the linger window plus the fixed device dispatch.
-        # Warm the 1k bucket first so its compile doesn't pollute samples.
+        # (~8k). Submitted as the INTERACTIVE class — the latency-bound
+        # path a node's verify_signed actually rides — so these tails
+        # measure the short-deadline flush, not the bulk linger.
         # (--smoke holds BATCH below the crossover, so `sub` stays on the
         # host route there — same submit shape, no kernel compile.)
-        sub = k1_triples[:1024]
-        assert all(batcher.submit_group(sub).result(timeout=900))
         mid = []
-        for _ in range(3 if SMOKE else 9):
+        for _ in range(3 if SMOKE else 11):
             t0 = time.perf_counter()
-            assert all(batcher.submit_group(sub).result(timeout=120))
+            assert all(batcher.submit_group(
+                sub, latency_class="interactive").result(timeout=120))
             mid.append(time.perf_counter() - t0)
-        p50_1k_ms = sorted(mid)[len(mid) // 2] * 1000.0
+        mid.sort()
+        p50_1k_ms = mid[len(mid) // 2] * 1000.0
+        p90_1k_ms = _pctl(mid, 0.90) * 1000.0
+        p99_1k_ms = _pctl(mid, 0.99) * 1000.0
         # the numbers above are only device numbers if the device was
         # actually used: an open breaker means some batches silently took
         # the host path, which would corrupt the bench without failing it
@@ -287,8 +319,16 @@ def service_metrics(k1_items, ed_items, r1_items):
     snap = registry.snapshot()
     stages = stage_percentiles(snap)
     overlap = snap.get("SigBatcher.PrepActive", {}).get("max", 0)
-    return (k1_rate, ed_rate, r1_rate, mixed_rate, p50_ms, p50_1k_ms,
-            stages, overlap)
+    return {
+        "k1_rate": k1_rate, "ed_rate": ed_rate, "r1_rate": r1_rate,
+        "mixed_rate": mixed_rate, "p50_ms": p50_ms, "p50_1k_ms": p50_1k_ms,
+        "p90_1k_ms": p90_1k_ms, "p99_1k_ms": p99_1k_ms, "stages": stages,
+        "overlap": overlap,
+        "post_warmup_compiles": prof.compiles_since_warm(),
+        "bucket_ladder": list(batcher._default_ladder),
+        "interactive_latency_ms": batcher.interactive_latency_s * 1000.0,
+        "interactive_batch": batcher.interactive_batch,
+    }
 
 
 def main() -> None:
@@ -308,9 +348,15 @@ def main() -> None:
         dev = device_rate(items)
         ed_dev = ed_device_rate(ed_items)
         r1_dev, r1_fallback_pct = r1_device_rate(r1_items)
-    (k1_rate, ed_rate, r1_rate, mixed_rate, p50_ms, p50_1k_ms, stages,
-     overlap) = service_metrics(items, ed_items, r1_items)
+    svc = service_metrics(items, ed_items, r1_items)
     host = host_baseline_rate(items[: min(128, BATCH)])
+
+    def _ratio(service, kernel):
+        # service throughput as a fraction of the raw kernel rate — the
+        # continuous-batching headline (≥0.9 target). 0.0 in smoke (kernel
+        # rates aren't measured there) so benchguard skips it.
+        return round(service / kernel, 4) if kernel > 0 else 0.0
+
     out = {
         "metric": "ecdsa_secp256k1_verifies_per_sec_per_chip",
         "value": round(dev, 1),
@@ -320,18 +366,27 @@ def main() -> None:
         "secp256r1_verifies_per_sec_per_chip": round(r1_dev, 1),
         "r1_halfgcd_fallback_pct": round(r1_fallback_pct, 4),
         "r1_doublings_per_op": R1_DOUBLINGS_PER_OP,
-        "service_path_verifies_per_sec": round(k1_rate, 1),
-        "ed25519_service_path_verifies_per_sec": round(ed_rate, 1),
-        "secp256r1_service_path_verifies_per_sec": round(r1_rate, 1),
-        "mixed_service_path_verifies_per_sec": round(mixed_rate, 1),
-        "tx_verify_p50_ms_batch1": round(p50_ms, 3),
-        "tx_verify_p50_ms_batch1k": round(p50_1k_ms, 3),
+        "service_path_verifies_per_sec": round(svc["k1_rate"], 1),
+        "ed25519_service_path_verifies_per_sec": round(svc["ed_rate"], 1),
+        "secp256r1_service_path_verifies_per_sec": round(svc["r1_rate"], 1),
+        "mixed_service_path_verifies_per_sec": round(svc["mixed_rate"], 1),
+        "service_to_kernel_ratio_k1": _ratio(svc["k1_rate"], dev),
+        "service_to_kernel_ratio_ed25519": _ratio(svc["ed_rate"], ed_dev),
+        "service_to_kernel_ratio_r1": _ratio(svc["r1_rate"], r1_dev),
+        "tx_verify_p50_ms_batch1": round(svc["p50_ms"], 3),
+        "tx_verify_p50_ms_batch1k": round(svc["p50_1k_ms"], 3),
+        "tx_verify_p90_ms_batch1k": round(svc["p90_1k_ms"], 3),
+        "tx_verify_p99_ms_batch1k": round(svc["p99_1k_ms"], 3),
         "host_baseline_verifies_per_sec": round(host, 1),
         "unique_signatures": UNIQUE,
         "prep_workers": SignatureBatcher.PREP_WORKERS,
         "prep_inflight_depth": SignatureBatcher.MAX_IN_FLIGHT,
-        "prep_overlap_max": overlap,
-        **stages,
+        "prep_overlap_max": svc["overlap"],
+        "post_warmup_compiles": svc["post_warmup_compiles"],
+        "bucket_ladder": svc["bucket_ladder"],
+        "interactive_latency_ms": svc["interactive_latency_ms"],
+        "interactive_batch": svc["interactive_batch"],
+        **svc["stages"],
     }
     # flight-recorder fields (corda_tpu.observability.profiling): where the
     # wall time went — XLA compiles vs cached dispatches, how full the
@@ -345,6 +400,27 @@ def main() -> None:
     out["prep_overlap_pct"] = round(prof.overlap.snapshot()["overlap_pct"], 2)
     if SMOKE:
         out["smoke"] = True
+        # pipeline-serialization tripwires, cheap enough for tier-1: the
+        # smoke run stays on the host route (no device intervals, so
+        # overlap_pct is 0 by construction) — concurrent flushes on the
+        # prep pool (PrepActive high-water ≥ 2) are its overlap signal,
+        # and the hot-cache discipline must show ZERO compiles after
+        # mark_warm(). A full bench run asserts the real overlap_pct via
+        # benchguard instead.
+        problems = []
+        if out["prep_overlap_max"] < 2:
+            problems.append(
+                f"prep_overlap_max={out['prep_overlap_max']} < 2: scheme "
+                f"flushes serialized — continuous planner not overlapping")
+        if out["post_warmup_compiles"] != 0:
+            problems.append(
+                f"post_warmup_compiles={out['post_warmup_compiles']} != 0: "
+                f"steady state recompiled after warmup")
+        if problems:
+            print(json.dumps(out))
+            for p in problems:
+                print(f"BENCH INVALID: {p}", file=sys.stderr)
+            sys.exit(1)
     print(json.dumps(out))
     if GUARD:
         from corda_tpu.tools.benchguard import guard_current

@@ -136,8 +136,11 @@ class KernelProfiler:
     def __init__(self):
         self._lock = threading.Lock()
         self._kernels: dict[str, _KernelStats] = {}
-        # scheme -> [live_total, capacity_total, last_pct]
+        # scheme -> [live_total, capacity_total, last_pct, batches]
         self._occupancy: dict[str, list] = {}
+        # compile count stamped by mark_warm(); compiles_since_warm() is the
+        # steady-state regression signal (a hot jit cache must stop growing)
+        self._warm_compiles = 0
         # fallback compile detection for callables without _cache_size:
         # kernel name -> set of seen arg-shape signatures
         self._seen_sigs: dict[str, set] = {}
@@ -214,11 +217,21 @@ class KernelProfiler:
             return
         pct = 100.0 * live / capacity
         with self._lock:
-            row = self._occupancy.setdefault(scheme, [0, 0, 0.0])
+            row = self._occupancy.setdefault(scheme, [0, 0, 0.0, 0])
             row[0] += live
             row[1] += capacity
             row[2] = pct
+            row[3] += 1
         self.occupancy_hist.update(pct)
+
+    def occupancy_mean_live(self) -> dict:
+        """Mean live items per device batch, per scheme — the signal the
+        batcher's bucket-ladder tuner reads (SignatureBatcher
+        .ladder_from_occupancy): sustained small batches pull the ladder
+        floor down, sustained megabatches push it up."""
+        with self._lock:
+            return {scheme: row[0] / row[3]
+                    for scheme, row in self._occupancy.items() if row[3]}
 
     # -- device-wait attribution --------------------------------------------
     def note_pending(self, handle, name: str) -> None:
@@ -245,6 +258,20 @@ class KernelProfiler:
             st.device_wait_s += seconds
         self.device_wait_hist.update(seconds)
 
+    # -- warmup boundary ----------------------------------------------------
+    def mark_warm(self) -> None:
+        """Stamp the current compile count as the warmup boundary. Any
+        compile after this is a steady-state cache miss — the bench smoke
+        gate asserts compiles_since_warm() == 0 after the warm phase."""
+        with self._lock:
+            self._warm_compiles = sum(s.compiles
+                                      for s in self._kernels.values())
+
+    def compiles_since_warm(self) -> int:
+        with self._lock:
+            total = sum(s.compiles for s in self._kernels.values())
+            return max(0, total - self._warm_compiles)
+
     # -- aggregate views ----------------------------------------------------
     def compile_totals(self) -> dict:
         with self._lock:
@@ -259,8 +286,8 @@ class KernelProfiler:
     def occupancy_pct_per_scheme(self) -> dict:
         with self._lock:
             return {scheme: round(100.0 * live / cap, 2)
-                    for scheme, (live, cap, _last) in self._occupancy.items()
-                    if cap}
+                    for scheme, (live, cap, *_rest)
+                    in self._occupancy.items() if cap}
 
     def snapshot(self) -> dict:
         """The /debug/profile payload: everything the recorder knows."""
@@ -270,8 +297,10 @@ class KernelProfiler:
                 scheme: {"live_total": live, "capacity_total": cap,
                          "occupancy_pct":
                              round(100.0 * live / cap, 2) if cap else 0.0,
-                         "last_batch_pct": round(last, 2)}
-                for scheme, (live, cap, last) in self._occupancy.items()}
+                         "last_batch_pct": round(last, 2),
+                         "batches": batches}
+                for scheme, (live, cap, last, batches)
+                in self._occupancy.items()}
         return {
             "kernels": kernels,
             "occupancy": occupancy,
@@ -319,6 +348,7 @@ class KernelProfiler:
             self._occupancy.clear()
             self._seen_sigs.clear()
             self._pending.clear()
+            self._warm_compiles = 0
         self.overlap = OverlapTracker()
         self.dispatch_hist = Histogram()
         self.device_wait_hist = Histogram()

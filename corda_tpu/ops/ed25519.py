@@ -562,7 +562,8 @@ SPLIT_B_WINDOW = 16
 
 
 def prepare_batch_split(items: list[tuple[bytes, bytes, bytes]],
-                        w: int = SPLIT_B_WINDOW, device_tables: bool = True):
+                        w: int = SPLIT_B_WINDOW, device_tables: bool = True,
+                        staging=None):
     """Host prep for the split-k kernel: signatures parsed by numpy (the
     wire bytes ARE little-endian u16 limbs), per-signer (−A, −A') rows from
     the _signer_row cache, SHA-512 challenges via hashlib, and the scalar
@@ -573,7 +574,12 @@ def prepare_batch_split(items: list[tuple[bytes, bytes, bytes]],
     from . import scalarprep as sp
     assert w == 16, "split prep emits 16-bit constant-base windows"
     n = len(items)
-    rows = np.empty((n, 6, F.NLIMB), dtype=np.uint16)
+    # ``staging`` (ops.staging.StagingLease) reuses the largest per-batch
+    # host buffer across flushes of the same bucket size — every row is
+    # overwritten below, so carried-over data never leaks into a verdict
+    rows = (staging.take("ed.rows", (n, 6, F.NLIMB), np.uint16)
+            if staging is not None
+            else np.empty((n, 6, F.NLIMB), dtype=np.uint16))
     precheck = np.ones(n, dtype=bool)
     digests: list[bytes] = []
     sub = _substitute_row()
@@ -660,26 +666,51 @@ def verify_batch(items: list[tuple[bytes, bytes, bytes]]) -> np.ndarray:
     return finish_batch(pending)
 
 
+def _service_kernel_split():
+    """Donated-jit twin of ``_verify_kernel_split`` for the async service
+    path: the four per-batch wire arrays (bb_idx, a_digits, rows,
+    r_packed) are donated so XLA reuses their device memory; the six
+    Niels table args are committed device_table_cache buffers and are
+    NEVER donated. Separate from the plain handle so synchronous callers
+    that re-invoke with the same prepared args (bench's _kernel_rate)
+    keep valid buffers."""
+    return F.donating_jit("ed25519.split.donated", verify_core_split,
+                          (0, 1, 2, 3), static_argnames=("w",))
+
+
 def verify_batch_async(items: list[tuple[bytes, bytes, bytes]]):
     """Dispatch without forcing (see weierstrass.verify_batch_async): the
     device computes while the caller preps the next batch. Rides the
     split-k half-length ladder — the fastest measured path (BASELINE.md
-    round 5). Dispatches go through the kernel flight recorder
-    (observability.profiling): compile-cache accounting + batch occupancy."""
+    round 5) — with donated per-batch device buffers and leased host
+    staging arrays (ops.staging) on the service path. Dispatches go
+    through the kernel flight recorder (observability.profiling):
+    compile-cache accounting + batch occupancy."""
     from ..observability.profiling import get_profiler
+    from .staging import get_staging_pool
     n = len(items)
     if n == 0:
         return (None, np.zeros(0, dtype=bool), 0)
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
-    *args, precheck = prepare_batch_split(padded, SPLIT_B_WINDOW)
-    dev = get_profiler().call("ed25519.split", _verify_kernel_split, *args,
-                              w=SPLIT_B_WINDOW, live=n,
+    pool = get_staging_pool()
+    lease = pool.lease()
+    *args, precheck = prepare_batch_split(padded, SPLIT_B_WINDOW,
+                                          staging=lease)
+    dev = get_profiler().call("ed25519.split", _service_kernel_split(),
+                              *args, w=SPLIT_B_WINDOW, live=n,
                               capacity=len(padded), scheme="ed25519")
-    return (dev, precheck, n)
+    pending = (dev, precheck, n)
+    # the lease rides the pending handle: finish_batch releases it after
+    # the force, the earliest point the device provably no longer reads
+    # the staged host memory (CPU jnp.asarray zero-copies; TPU H2D is
+    # async)
+    pool.attach(pending, lease)
+    return pending
 
 
 def finish_batch(pending) -> np.ndarray:
     from ..observability.profiling import get_profiler
+    from .staging import get_staging_pool
     dev, precheck, n = pending
     if n == 0:
         return np.zeros(0, dtype=bool)
@@ -688,4 +719,8 @@ def finish_batch(pending) -> np.ndarray:
     t0 = _time.perf_counter()
     ok = np.asarray(dev)
     prof.device_wait(name, _time.perf_counter() - t0)
+    # forced above → the staged host buffers are free for the next batch
+    # (on a failed force the lease stays attached and is evicted, never
+    # reused — a crash cannot corrupt a later batch)
+    get_staging_pool().release_for(pending)
     return (ok & precheck)[:n]

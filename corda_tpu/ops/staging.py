@@ -1,0 +1,144 @@
+"""Reused host staging buffers for device dispatch (zero-copy churn).
+
+Every flush used to allocate fresh numpy arrays for the kernel wire form
+(padded word rows, packed limb rows) and drop them after dispatch — at
+32k-row service batches that is tens of MB of allocator traffic per flush,
+and on TPU every new host buffer is a fresh pin for the DMA engine. A
+steady-state verification server re-sees the same shapes over and over
+(the batcher cuts drains at a power-of-two bucket ladder exactly so shapes
+recur), which makes the vLLM-style answer apply: keep freed buffers in a
+free list keyed by (tag, shape, dtype) and hand the same memory back.
+
+Safety: a staging buffer may alias in-flight device work — on CPU,
+``jnp.asarray`` zero-copies numpy memory, and on TPU the host→device
+transfer is asynchronous — so buffers are handed out under a *lease* and
+return to the free pool only when the batch's device result has been
+FORCED (the ops ``finish_batch`` force is the earliest provably-safe
+point). A lease that is never released (a dispatch crashed before finish)
+is simply dropped: its buffers are garbage-collected instead of reused,
+so a failure can never corrupt a later batch.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+#: Free-list depth per (tag, shape, dtype) key: MAX_IN_FLIGHT batches plus
+#: slack for the prep pool racing ahead. Beyond this, returned buffers are
+#: dropped to the allocator (bounded memory, not a cache of every shape
+#: ever seen).
+MAX_FREE_PER_KEY = 8
+
+#: Cap on leases parked against un-finished pending handles: entries are
+#: popped on finish, so growth only happens when dispatches are abandoned
+#: (device failure → host fallback). Evicted leases are dropped, never
+#: recycled.
+MAX_ATTACHED = 128
+
+
+class StagingLease:
+    """One batch's set of staging buffers, checked out until released."""
+
+    __slots__ = ("_pool", "_taken", "_released")
+
+    def __init__(self, pool: "StagingPool"):
+        self._pool = pool
+        self._taken: list[tuple[tuple, np.ndarray]] = []
+        self._released = False
+
+    def take(self, tag: str, shape: tuple, dtype) -> np.ndarray:
+        """A writable ndarray of (shape, dtype) — reused from the pool
+        when a previous batch of the same shape has finished, freshly
+        allocated otherwise. The caller must overwrite every row it
+        dispatches (reused memory carries the previous batch's data)."""
+        if self._released:
+            raise RuntimeError("staging lease already released")
+        key = (tag, tuple(shape), np.dtype(dtype).str)
+        buf = self._pool._checkout(key)
+        if buf is None:
+            buf = np.empty(shape, dtype=dtype)
+        self._taken.append((key, buf))
+        return buf
+
+    def release(self) -> None:
+        """Return every taken buffer to the pool's free lists. Idempotent.
+        Only call once the device no longer references the memory (after
+        the batch's result force)."""
+        if self._released:
+            return
+        self._released = True
+        self._pool._reclaim(self._taken)
+        self._taken = []
+
+
+class StagingPool:
+    """Process-wide free lists of staging buffers plus the pending-handle
+    side table that ties a lease's lifetime to its batch's finish."""
+
+    def __init__(self, max_free_per_key: int = MAX_FREE_PER_KEY,
+                 max_attached: int = MAX_ATTACHED):
+        self._lock = threading.Lock()
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        self._attached: OrderedDict = OrderedDict()
+        self._max_free = max_free_per_key
+        self._max_attached = max_attached
+        self.hits = 0
+        self.misses = 0
+
+    def lease(self) -> StagingLease:
+        return StagingLease(self)
+
+    def _checkout(self, key: tuple):
+        with self._lock:
+            bufs = self._free.get(key)
+            if bufs:
+                self.hits += 1
+                return bufs.pop()
+            self.misses += 1
+            return None
+
+    def _reclaim(self, taken) -> None:
+        with self._lock:
+            for key, buf in taken:
+                bufs = self._free.setdefault(key, [])
+                if len(bufs) < self._max_free:
+                    bufs.append(buf)
+
+    # -- pending-handle attachment ------------------------------------------
+    def attach(self, handle, lease: StagingLease) -> None:
+        """Park ``lease`` against an async pending handle; ``release_for``
+        (called by finish_batch after the force) reclaims it. The table is
+        bounded: abandoned handles evict oldest-first, and an evicted
+        lease's buffers are dropped, never reused."""
+        with self._lock:
+            self._attached[id(handle)] = lease
+            while len(self._attached) > self._max_attached:
+                self._attached.popitem(last=False)
+
+    def release_for(self, handle) -> None:
+        with self._lock:
+            lease = self._attached.pop(id(handle), None)
+        if lease is not None:
+            lease.release()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "free_buffers": sum(len(v) for v in self._free.values()),
+                    "attached": len(self._attached)}
+
+
+_POOL = StagingPool()
+
+
+def get_staging_pool() -> StagingPool:
+    """The process staging pool — fetched per operation so tests can swap
+    it with set_staging_pool()."""
+    return _POOL
+
+
+def set_staging_pool(pool: StagingPool) -> None:
+    global _POOL
+    _POOL = pool

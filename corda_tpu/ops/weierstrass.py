@@ -1535,12 +1535,35 @@ def verify_batch(curve: WeierstrassCurve,
     return (ok & precheck)[:n]
 
 
+def _service_kernel_hybrid_wide():
+    """Donated-jit twin of ``_verify_kernel_hybrid_wide`` for the async
+    service path: the four per-batch wire arrays (g_idx, q_bits, pts,
+    r_limbs) are donated so XLA reuses their device memory for the
+    batch's temporaries; the G-table args are committed
+    device_table_cache buffers and are NEVER donated. Kept separate from
+    the plain handle so synchronous callers that re-invoke with the same
+    prepared args (bench's _kernel_rate) keep valid buffers."""
+    return F.donating_jit("weierstrass.hybrid_wide.donated",
+                          verify_core_hybrid_wide, (0, 1, 2, 3),
+                          static_argnames=("g_w",))
+
+
+def _service_kernel_r1_split():
+    """Donated-jit twin of ``_verify_kernel_r1_split`` (same rules as
+    :func:`_service_kernel_hybrid_wide`; argnum 2 donates the whole Q
+    2-tuple pytree)."""
+    return F.donating_jit("weierstrass.r1_split.donated",
+                          verify_core_r1_split, (0, 1, 2, 3),
+                          static_argnames=("curve_name", "w"))
+
+
 def verify_batch_async(curve: WeierstrassCurve,
                        items: list[tuple[tuple, bytes, int, int]]):
     """Dispatch a verify batch WITHOUT forcing the result: returns an opaque
     pending handle for :func:`finish_batch`. The device computes while the
     caller preps the next batch (the service batcher's one-deep pipeline —
-    host prep was ~2/3 of the unpipelined service-path cost)."""
+    host prep was ~2/3 of the unpipelined service-path cost). Per-batch
+    device buffers are donated (see :func:`_service_kernel_hybrid_wide`)."""
     from ..observability.profiling import get_profiler
     prof = get_profiler()
     n = len(items)
@@ -1549,13 +1572,14 @@ def verify_batch_async(curve: WeierstrassCurve,
     padded = items + [items[-1]] * (F.bucket_size(n) - n)
     if curve.name == "secp256k1":
         *args, precheck = prepare_batch_hybrid_wide(padded, HYBRID_G_WINDOW)
-        return (prof.call("weierstrass.hybrid_k1", _verify_kernel_hybrid_wide,
+        return (prof.call("weierstrass.hybrid_k1",
+                          _service_kernel_hybrid_wide(),
                           *args, g_w=HYBRID_G_WINDOW, live=n,
                           capacity=len(padded), scheme=curve.name),
                 precheck, n)
     if curve.name == "secp256r1":
         *args, precheck, forced = prepare_batch_r1_split(curve, padded)
-        return (prof.call("weierstrass.r1_split", _verify_kernel_r1_split,
+        return (prof.call("weierstrass.r1_split", _service_kernel_r1_split(),
                           *args, curve_name=curve.name, w=R1_G_WINDOW,
                           live=n, capacity=len(padded), scheme=curve.name),
                 precheck, n, forced)
@@ -1582,16 +1606,27 @@ def words_prep_available(curve: WeierstrassCurve) -> bool:
     return False
 
 
-def pad_word_rows(arrays, m: int):
+def pad_word_rows(arrays, m: int, staging=None, tags=None):
     """Pad each (B, ·) word-row array to m rows by replicating the last row
     (the word-form analog of verify_batch_async's last-item padding — a
     repeated valid row verifies identically and is sliced off by
-    finish_batch)."""
+    finish_batch). With a staging lease, the padded rows land in reused
+    pool buffers (one per tag) instead of fresh concatenations — the
+    zero-copy-churn seam for the service path's steady-state shapes."""
     n = len(arrays[0])
-    if m <= n:
-        return arrays
-    return tuple(np.concatenate([a, np.repeat(a[-1:], m - n, axis=0)])
-                 for a in arrays)
+    if staging is None:
+        if m <= n:
+            return arrays
+        return tuple(np.concatenate([a, np.repeat(a[-1:], m - n, axis=0)])
+                     for a in arrays)
+    out = []
+    for a, tag in zip(arrays, tags):
+        buf = staging.take(tag, (m,) + a.shape[1:], a.dtype)
+        buf[:n] = a
+        if m > n:
+            buf[n:] = a[-1]
+        out.append(buf)
+    return tuple(out)
 
 
 def verify_batch_async_words(curve: WeierstrassCurve, e_words, r_words,
@@ -1601,28 +1636,44 @@ def verify_batch_async_words(curve: WeierstrassCurve, e_words, r_words,
     pub rows from keys.sec1_pub_row_cached, r/s from the batched DER
     parse, e from digests_to_words), skipping the per-item decompress +
     DER + to_bytes loop entirely. Same pending/finish contract as
-    :func:`verify_batch_async`; callers gate on words_prep_available."""
+    :func:`verify_batch_async`; callers gate on words_prep_available.
+    Padding goes through reused staging buffers and the kernel call uses
+    the donated twin, so steady-state flushes neither allocate fresh host
+    rows nor leave stale device input buffers behind."""
     from ..observability.profiling import get_profiler
+    from .staging import get_staging_pool
     prof = get_profiler()
     n = len(e_words)
     if n == 0:
         return (None, np.zeros(0, dtype=bool), 0)
     capacity = F.bucket_size(n)
+    pool = get_staging_pool()
+    # On any exception below the lease is simply dropped (never released):
+    # a partial dispatch may still alias the buffers, so they must not
+    # re-enter the free pool.
+    lease = pool.lease()
+    tags = tuple(f"{curve.name}.{t}" for t in ("e", "r", "s", "pub"))
     e_words, r_words, s_words, pub_words = pad_word_rows(
-        (e_words, r_words, s_words, pub_words), capacity)
+        (e_words, r_words, s_words, pub_words), capacity,
+        staging=lease, tags=tags)
     if curve.name == "secp256k1":
         *args, precheck = _prepare_hybrid_native_words(
             e_words, r_words, s_words, pub_words, HYBRID_G_WINDOW)
-        return (prof.call("weierstrass.hybrid_k1", _verify_kernel_hybrid_wide,
-                          *args, g_w=HYBRID_G_WINDOW, live=n,
-                          capacity=capacity, scheme=curve.name),
-                precheck, n)
-    *args, precheck, forced = _prepare_r1_split_native_words(
-        e_words, r_words, s_words, pub_words, R1_G_WINDOW)
-    return (prof.call("weierstrass.r1_split", _verify_kernel_r1_split,
-                      *args, curve_name=curve.name, w=R1_G_WINDOW,
-                      live=n, capacity=capacity, scheme=curve.name),
-            precheck, n, forced)
+        pending = (prof.call("weierstrass.hybrid_k1",
+                             _service_kernel_hybrid_wide(),
+                             *args, g_w=HYBRID_G_WINDOW, live=n,
+                             capacity=capacity, scheme=curve.name),
+                   precheck, n)
+    else:
+        *args, precheck, forced = _prepare_r1_split_native_words(
+            e_words, r_words, s_words, pub_words, R1_G_WINDOW)
+        pending = (prof.call("weierstrass.r1_split",
+                             _service_kernel_r1_split(),
+                             *args, curve_name=curve.name, w=R1_G_WINDOW,
+                             live=n, capacity=capacity, scheme=curve.name),
+                   precheck, n, forced)
+    pool.attach(pending, lease)
+    return pending
 
 
 def finish_batch(pending) -> np.ndarray:
@@ -1631,8 +1682,11 @@ def finish_batch(pending) -> np.ndarray:
     (dev, precheck_eff, n, forced) — forced carries the host-oracle
     verdicts of the per-item fallbacks masked out of precheck_eff.
     The force wall time lands in the flight recorder as device wait,
-    attributed to the dispatching kernel via the pending handle."""
+    attributed to the dispatching kernel via the pending handle. After the
+    force the batch's staging lease (if any) returns to the pool — the
+    earliest point the host rows provably no longer alias device work."""
     from ..observability.profiling import get_profiler
+    from .staging import get_staging_pool
     dev, precheck, n, *rest = pending
     if n == 0:
         return np.zeros(0, dtype=bool)
@@ -1641,6 +1695,7 @@ def finish_batch(pending) -> np.ndarray:
     t0 = time.perf_counter()
     forced_dev = np.asarray(dev)
     prof.device_wait(name, time.perf_counter() - t0)
+    get_staging_pool().release_for(pending)
     ok = forced_dev & precheck
     if rest:
         ok = ok | rest[0]

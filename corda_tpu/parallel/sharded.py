@@ -22,10 +22,23 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import ed25519 as ed_ops
+from ..ops import field as F
 from ..ops import sha256 as sha_ops
 from ..ops import weierstrass as wc_ops
+from ..ops.staging import get_staging_pool
 
 AXIS = "chips"
+
+
+def _jit_donating_batch(shmapped, donate_argnums=(0, 1, 2, 3)):
+    """jit a shard_mapped verify kernel with its per-batch leading args
+    donated (the wire-form arrays rebuilt every flush), so XLA reuses
+    their device memory for the batch's temporaries. The replicated
+    constant tables at higher argnums are cached per mesh and must NEVER
+    be donated. CPU backends don't support donation — gated off there."""
+    if F.donation_supported():
+        return jax.jit(shmapped, donate_argnums=donate_argnums)
+    return jax.jit(shmapped)
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
@@ -101,7 +114,7 @@ def sharded_ed25519_verify_split(mesh: Mesh):
                   *((P(None, None),) * 6)),
         out_specs=P(AXIS),
         check_vma=False)  # see sharded_ed25519_verify
-    return jax.jit(shmapped)
+    return _jit_donating_batch(shmapped)
 
 
 def sharded_ecdsa_verify(mesh: Mesh, curve_name: str):
@@ -140,7 +153,7 @@ def sharded_ecdsa_verify_hybrid(mesh: Mesh):
                   P(None, None), P(None, None), P(None)),
         out_specs=P(AXIS),
         check_vma=False)  # see sharded_ed25519_verify
-    return jax.jit(shmapped)
+    return _jit_donating_batch(shmapped)
 
 
 def sharded_merkle_root(mesh: Mesh):
@@ -266,14 +279,20 @@ def sharded_verify_batch_secp256k1_words(mesh: Mesh, e_words, r_words,
     if n == 0:
         return np.zeros(0, dtype=bool)
     capacity = _pad_to_mesh_bucket(n, mesh)
+    # Padded rows go through reused staging buffers; resolve is synchronous
+    # here so the lease returns right after the force (dropped, never
+    # recycled, if the dispatch raises mid-flight).
+    lease = get_staging_pool().lease()
     e_words, r_words, s_words, pub_words = wc_ops.pad_word_rows(
-        (e_words, r_words, s_words, pub_words), capacity)
+        (e_words, r_words, s_words, pub_words), capacity, staging=lease,
+        tags=("mesh.k1.e", "mesh.k1.r", "mesh.k1.s", "mesh.k1.pub"))
     *args, precheck = wc_ops._prepare_hybrid_native_words(
         e_words, r_words, s_words, pub_words, wc_ops.HYBRID_G_WINDOW)
     fn, tabs = _k1_mesh_fn(mesh)
     ok = _forced(_profiler().call("sharded.hybrid_k1", fn, *args[:-3], *tabs,
                                   live=n, capacity=capacity,
                                   scheme="secp256k1"))
+    lease.release()
     return (ok & precheck)[:n]
 
 
@@ -296,7 +315,7 @@ def sharded_ecdsa_verify_r1_split(mesh: Mesh):
                   P(None, None), P(None, None), P(None)),
         out_specs=P(AXIS),
         check_vma=False)  # see sharded_ed25519_verify
-    return jax.jit(shmapped)
+    return _jit_donating_batch(shmapped)
 
 
 def _r1_mesh_fn(mesh: Mesh, _cache={}):
@@ -324,14 +343,17 @@ def sharded_verify_batch_secp256r1_words(mesh: Mesh, e_words, r_words,
     if n == 0:
         return np.zeros(0, dtype=bool)
     capacity = _pad_to_mesh_bucket(n, mesh)
+    lease = get_staging_pool().lease()  # see sharded_verify_batch_secp256k1_words
     e_words, r_words, s_words, pub_words = wc_ops.pad_word_rows(
-        (e_words, r_words, s_words, pub_words), capacity)
+        (e_words, r_words, s_words, pub_words), capacity, staging=lease,
+        tags=("mesh.r1.e", "mesh.r1.r", "mesh.r1.s", "mesh.r1.pub"))
     *args, precheck, forced = wc_ops._prepare_r1_split_native_words(
         e_words, r_words, s_words, pub_words, wc_ops.R1_G_WINDOW)
     fn, tabs = _r1_mesh_fn(mesh)
     ok = _forced(_profiler().call("sharded.r1_split", fn, *args[:-6], *tabs,
                                   live=n, capacity=capacity,
                                   scheme="secp256r1"))
+    lease.release()
     return ((ok & precheck) | forced)[:n]
 
 

@@ -37,6 +37,10 @@ import sys
 RATE_TOLERANCE = 0.15
 #: Best-so-far slack for lower-is-better latencies (see module docstring).
 LATENCY_TOLERANCE = 0.35
+#: Slack for the batch-1k tail latencies: p99 of 11 interactive submits is
+#: the single worst sample — one scheduler hiccup doubles it, so the
+#: ceiling absorbs 50% before calling it a pipeline regression.
+TAIL_TOLERANCE = 0.5
 
 #: metric name -> ("higher"|"lower", tolerance). "higher" guards a floor of
 #: best*(1-tol); "lower" a ceiling of best*(1+tol). host_baseline and the
@@ -53,15 +57,29 @@ GUARDED_METRICS: dict = {
     "mixed_service_path_verifies_per_sec": ("higher", RATE_TOLERANCE),
     "tx_verify_p50_ms_batch1": ("lower", LATENCY_TOLERANCE),
     "tx_verify_p50_ms_batch1k": ("lower", LATENCY_TOLERANCE),
+    # continuous-batching locks (PR 6): the service/kernel ratios keep the
+    # pipeline from quietly re-serializing (a ratio slide means the service
+    # seam — not the kernel — lost the win), and the 1k tails keep the
+    # interactive latency class honest under load.
+    "service_to_kernel_ratio_k1": ("higher", RATE_TOLERANCE),
+    "service_to_kernel_ratio_ed25519": ("higher", RATE_TOLERANCE),
+    "service_to_kernel_ratio_r1": ("higher", RATE_TOLERANCE),
+    "tx_verify_p90_ms_batch1k": ("lower", LATENCY_TOLERANCE),
+    "tx_verify_p99_ms_batch1k": ("lower", TAIL_TOLERANCE),
 }
 
 #: Fields every artifact must carry (the --smoke schema check; value types
-#: are checked when present). The four flight-recorder fields are listed so
-#: a wiring regression that silently drops them fails the smoke gate.
+#: are checked when present). The flight-recorder and continuous-batching
+#: fields are listed so a wiring regression that silently drops them fails
+#: the smoke gate.
 REQUIRED_FIELDS: tuple = (
     "metric", "value", "unit", "vs_baseline",
     "service_path_verifies_per_sec", "tx_verify_p50_ms_batch1",
     "tx_verify_p50_ms_batch1k",
+    "tx_verify_p90_ms_batch1k", "tx_verify_p99_ms_batch1k",
+    "service_to_kernel_ratio_k1", "service_to_kernel_ratio_ed25519",
+    "service_to_kernel_ratio_r1",
+    "post_warmup_compiles", "bucket_ladder",
     "compile_s_total", "compile_cache_hits",
     "occupancy_pct_per_scheme", "prep_overlap_pct",
 )
@@ -118,6 +136,10 @@ def schema_violations(current: dict) -> list[str]:
         elif name == "occupancy_pct_per_scheme":
             if not isinstance(current[name], dict):
                 problems.append(f"{name} should be a dict, got "
+                                f"{type(current[name]).__name__}")
+        elif name == "bucket_ladder":
+            if not isinstance(current[name], list):
+                problems.append(f"{name} should be a list, got "
                                 f"{type(current[name]).__name__}")
         elif name in ("metric", "unit"):
             if not isinstance(current[name], str):

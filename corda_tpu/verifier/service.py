@@ -133,10 +133,12 @@ class TpuTransactionVerifierService(TransactionVerifierService):
             # ONE group future for the whole signature set: per-signature
             # Future allocation measured ~25µs each — real money on
             # many-signature transactions (the batcher resolves the group
-            # with one lock acquire per flush)
+            # with one lock acquire per flush). Interactive class: a single
+            # tx's few signatures are latency-bound — they flush on the
+            # short deadline instead of lingering behind a bulk megabatch.
             group_future = self.batcher.submit_group(
                 [(sig.by, sig.bytes, stx.id.bytes) for sig in stx.sigs],
-                ctx=ctx)
+                ctx=ctx, latency_class="interactive")
 
             def work():
                 try:

@@ -31,8 +31,13 @@ def test_bench_smoke_emits_full_json_schema():
             "secp256r1_service_path_verifies_per_sec",
             "mixed_service_path_verifies_per_sec",
             "tx_verify_p50_ms_batch1", "tx_verify_p50_ms_batch1k",
+            "tx_verify_p90_ms_batch1k", "tx_verify_p99_ms_batch1k",
+            "service_to_kernel_ratio_k1", "service_to_kernel_ratio_ed25519",
+            "service_to_kernel_ratio_r1",
             "host_baseline_verifies_per_sec", "unique_signatures",
             "prep_workers", "prep_inflight_depth", "prep_overlap_max",
+            "post_warmup_compiles", "bucket_ladder",
+            "interactive_latency_ms", "interactive_batch",
             "stage_dispatch_ms_p50", "stage_dispatch_ms_p90",
             "stage_dispatch_ms_p99", "stage_finish_ms_p50",
             "verifier_batch_size_p50",
@@ -41,15 +46,19 @@ def test_bench_smoke_emits_full_json_schema():
             "occupancy_pct_per_scheme", "prep_overlap_pct"):
         assert field in out, f"missing JSON field: {field}"
     assert isinstance(out["occupancy_pct_per_scheme"], dict)
+    assert isinstance(out["bucket_ladder"], list) and out["bucket_ladder"]
     assert out["smoke"] is True
     # the service path actually ran: every scheme produced a nonzero rate,
-    # and the prep pool saw at least one flush in flight
+    # and the continuous planner overlapped flushes on the prep pool
+    # (bench's own smoke gate enforces >= 2 + zero post-warmup compiles
+    # before it even prints — this re-asserts from the artifact side)
     for rate in ("service_path_verifies_per_sec",
                  "ed25519_service_path_verifies_per_sec",
                  "secp256r1_service_path_verifies_per_sec",
                  "mixed_service_path_verifies_per_sec"):
         assert out[rate] > 0, rate
-    assert out["prep_overlap_max"] >= 1
+    assert out["prep_overlap_max"] >= 2
+    assert out["post_warmup_compiles"] == 0
 
 
 @pytest.mark.slow

@@ -22,6 +22,13 @@ def _artifact(**over):
         "mixed_service_path_verifies_per_sec": 150.0,
         "tx_verify_p50_ms_batch1": 1.0,
         "tx_verify_p50_ms_batch1k": 20.0,
+        "tx_verify_p90_ms_batch1k": 30.0,
+        "tx_verify_p99_ms_batch1k": 45.0,
+        "service_to_kernel_ratio_k1": 0.8,
+        "service_to_kernel_ratio_ed25519": 0.7,
+        "service_to_kernel_ratio_r1": 0.75,
+        "post_warmup_compiles": 0,
+        "bucket_ladder": [256, 512, 1024],
         "compile_s_total": 5.0, "compile_cache_hits": 7,
         "occupancy_pct_per_scheme": {"ed25519": 90.0},
         "prep_overlap_pct": 40.0,

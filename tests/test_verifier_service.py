@@ -114,9 +114,9 @@ def test_verify_signed_submits_one_group_per_tx(services):
     calls = []
     orig = svc.batcher.submit_group
 
-    def spy(checks, ctx=None):
+    def spy(checks, ctx=None, **kw):
         calls.append(len(checks))
-        return orig(checks, ctx=ctx)
+        return orig(checks, ctx=ctx, **kw)
 
     def reject(*a, **k):
         raise AssertionError("verify_signed must not use submit_many")

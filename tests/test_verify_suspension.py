@@ -79,7 +79,10 @@ def test_n_flows_one_device_batch():
     (impossible while flows blocked the node thread one at a time)."""
     network, node = make_network_node()
     svcs = seed_services(node)
-    batcher = SignatureBatcher(host_crossover=0, max_latency_s=0.25)
+    # verify_signed submits on the INTERACTIVE class (PR 6), so the
+    # cross-flow coalescing window is interactive_latency_s now
+    batcher = SignatureBatcher(host_crossover=0, max_latency_s=0.25,
+                               interactive_latency_s=0.25)
     node.services.verifier_service = TpuTransactionVerifierService(
         batcher=batcher)
     try:
