@@ -62,6 +62,7 @@ from corda_tpu.ops import weierstrass as wc_ops
 
 SMOKE = "--smoke" in sys.argv
 GUARD = "--guard" in sys.argv
+FLEET = "--fleet" in sys.argv
 # smoke: small enough that every per-scheme drain stays below the batcher's
 # host_crossover (192) even when REPS groups coalesce into one flush
 BATCH = int(os.environ.get("CORDA_TPU_BENCH_N", 48 if SMOKE else 32768))
@@ -331,6 +332,48 @@ def service_metrics(k1_items, ed_items, r1_items) -> dict:
     }
 
 
+def fleet_main() -> None:
+    """--fleet: the multi-worker topology bench (corda_tpu.verifier.fleet).
+    Smoke: 2 in-process host-route workers, no kernel compiles — a tier-1
+    wiring check that the router deals to BOTH workers and every future
+    resolves. Full: one device-pinned worker per local chip (the MULTICHIP
+    stage runs the same thing through __graft_entry__.dryrun_multichip)."""
+    from corda_tpu.verifier.fleet import fleet_bench
+    if SMOKE:
+        out = fleet_bench(2, groups=24, group_size=16, use_device=False)
+        out["smoke"] = True
+    else:
+        import jax
+        devices = jax.devices()
+        n = min(8, len(devices))
+        out = fleet_bench(n, groups=32 * n, group_size=256,
+                          use_device=True, devices=devices[:n],
+                          host_crossover=0)
+    out["fleet"] = True
+    problems = []
+    if out["n_workers"] != (2 if SMOKE else max(1, out["n_workers"])):
+        problems.append(f"n_workers={out['n_workers']}: fleet did not spawn")
+    idle = [w for w, c in out["per_worker_sigs"].items() if c <= 0]
+    if idle:
+        problems.append(f"workers {idle} processed nothing: the router "
+                        f"never dealt to them")
+    print(json.dumps(out))
+    if problems:
+        for p in problems:
+            print(f"BENCH INVALID: {p}", file=sys.stderr)
+        sys.exit(1)
+    if GUARD:
+        from corda_tpu.tools.benchguard import guard_multichip
+        failures = guard_multichip(out)
+        if failures:
+            print("BENCH REGRESSION: fleet metrics breached their "
+                  "trajectory floors:", file=sys.stderr)
+            for p in failures:
+                print(f"  {p}", file=sys.stderr)
+            sys.exit(1)
+        print("benchguard: ok", file=sys.stderr)
+
+
 def main() -> None:
     from corda_tpu.observability import get_profiler
     from corda_tpu.verifier.batcher import SignatureBatcher
@@ -435,4 +478,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    fleet_main() if FLEET else main()

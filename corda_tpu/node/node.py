@@ -55,6 +55,11 @@ class NodeConfiguration:
     # N verifier JVMs, Verifier.kt:42-79; a TPU host scales ACROSS ITS
     # SLICE instead). None = single chip.
     mesh_devices: int | None = None
+    # with verifier_type=OutOfProcess: how many fleet workers the operator
+    # runs against this node's queue. The node works with any number
+    # attached (competing consumers); /readyz reports fewer-than-expected
+    # as a degraded fleet. None = no expectation.
+    verifier_workers: int | None = None
     key_seed_hex: str | None = None    # deterministic identity (tests)
     tls: bool = False                  # mutual TLS on the TCP plane
     # shared dev-CA directory (all nodes of one network must agree);
@@ -74,6 +79,12 @@ class NodeConfiguration:
                 "mesh_devices requires verifier_type=Tpu "
                 f"(got {self.verifier_type!r}; for OutOfProcess, "
                 "pass --mesh-devices to the verifier worker)")
+        if (self.verifier_workers is not None
+                and self.verifier_type != "OutOfProcess"):
+            raise ValueError(
+                "verifier_workers requires verifier_type=OutOfProcess "
+                f"(got {self.verifier_type!r}) — only the out-of-process "
+                "queue has a worker fleet to expect")
 
     @staticmethod
     def load(path: str) -> "NodeConfiguration":
@@ -234,8 +245,9 @@ class Node:
         if self.config.verifier_type == "OutOfProcess":
             from ..verifier.out_of_process import (
                 OutOfProcessTransactionVerifierService)
-            return OutOfProcessTransactionVerifierService(self.messaging,
-                                                          metrics=metrics)
+            return OutOfProcessTransactionVerifierService(
+                self.messaging, metrics=metrics,
+                expected_workers=self.config.verifier_workers)
         kwargs = {"metrics": metrics}
         if self.config.mesh_devices is not None:
             from ..parallel import make_mesh

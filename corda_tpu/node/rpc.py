@@ -246,6 +246,18 @@ class CordaRPCOps:
                     # so the node keeps taking traffic while operators
                     # see exactly which breaker tripped
                     degraded["device_breakers"] = open_schemes
+        fleet_fn = getattr(svc, "fleet_status", None)
+        if fleet_fn is not None:
+            # out-of-process fleet: unready with NO workers attached (work
+            # would queue forever); degraded — still serving — when fewer
+            # than the configured fleet size are attached
+            fleet = fleet_fn()
+            checks["fleet_workers_attached"] = fleet["attached"] > 0
+            if fleet.get("degraded"):
+                degraded["fleet"] = {
+                    "expected": fleet["expected"],
+                    "attached": fleet["attached"],
+                    "workers": sorted(fleet["workers"])}
         notary = getattr(self.hub, "notary_service", None)
         if notary is not None:
             raft = getattr(notary.uniqueness, "raft", None)

@@ -9,6 +9,8 @@ axis) with an `all_gather` root combine over ICI.
 """
 from .sharded import (  # noqa: F401
     make_mesh,
+    make_shard_mesh,
+    shard_devices,
     sharded_ed25519_verify,
     sharded_ecdsa_verify,
     sharded_ecdsa_verify_hybrid,

@@ -52,6 +52,39 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (AXIS,))
 
 
+def shard_devices(n_shards: int, devices=None) -> list:
+    """Contiguous split of the visible devices into ``n_shards`` non-empty
+    groups (the verifier fleet's device partition: worker i owns group i).
+    Remainder devices go to the LOW shards, so capacities differ by at most
+    one and the fleet router's capacity normalization stays honest."""
+    if devices is None:
+        devices = jax.devices()
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    if n_shards > len(devices):
+        raise ValueError(f"need {n_shards} devices for {n_shards} shards, "
+                         f"have {len(devices)}")
+    base, extra = divmod(len(devices), n_shards)
+    out, i = [], 0
+    for s in range(n_shards):
+        k = base + (1 if s < extra else 0)
+        out.append(list(devices[i:i + k]))
+        i += k
+    return out
+
+
+def make_shard_mesh(shard_index: int, n_shards: int, devices=None) -> Mesh:
+    """1-D mesh over shard ``shard_index`` of ``n_shards`` — a multi-device
+    fleet worker's private mesh (`--shard-index/--num-shards` CLI seam).
+    Single-device shards should pin ``SignatureBatcher(device=...)``
+    instead (a 1-device mesh pays shard_map overhead for nothing)."""
+    shards = shard_devices(n_shards, devices)
+    if not 0 <= shard_index < n_shards:
+        raise ValueError(f"shard_index {shard_index} out of range "
+                         f"[0, {n_shards})")
+    return make_mesh(devices=shards[shard_index])
+
+
 def _check_batch(b: int, mesh: Mesh, what: str) -> None:
     n = mesh.devices.size
     if b % n:

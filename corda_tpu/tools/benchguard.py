@@ -182,6 +182,121 @@ def default_trajectory_paths(root: str | None = None) -> list[str]:
     return sorted(_glob.glob(os.path.join(root, "BENCH_r*.json")))
 
 
+# ---------------------------------------------------------------------------
+# MULTICHIP (fleet) trajectory
+# ---------------------------------------------------------------------------
+
+#: Fleet metrics locked from the MULTICHIP trajectory. scaling_efficiency
+#: is busy-time based (corda_tpu.verifier.fleet) — a drop means workers
+#: started idling while a straggler held work, i.e. routing or stealing
+#: regressed, so it gets the tighter rate tolerance.
+MULTICHIP_GUARDED: dict = {
+    "fleet_verifies_per_sec": ("higher", RATE_TOLERANCE),
+    "scaling_efficiency_pct": ("higher", RATE_TOLERANCE),
+}
+
+#: Fields every fleet artifact must carry (the --smoke --fleet schema gate).
+MULTICHIP_REQUIRED: tuple = (
+    "fleet_verifies_per_sec", "scaling_efficiency_pct", "n_workers",
+    "n_devices", "fleet_steals", "per_worker_sigs",
+)
+
+
+def parse_multichip_artifact(obj: dict) -> dict | None:
+    """A MULTICHIP artifact wraps the stage's raw stdout under ``tail``;
+    the fleet stage prints its JSON line LAST, so scan the tail's lines
+    from the end for a JSON object carrying fleet_verifies_per_sec.
+    Pre-fleet artifacts have an empty tail → None (not part of the
+    trajectory). A dict that already carries the field (bench.py --fleet
+    output, or a harness ``parsed`` wrapper) passes through."""
+    obj = parse_artifact(obj)
+    if "fleet_verifies_per_sec" in obj:
+        return obj
+    tail = obj.get("tail")
+    if not isinstance(tail, str) or not tail.strip():
+        return None
+    for line in reversed(tail.strip().splitlines()):
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "fleet_verifies_per_sec" in parsed:
+            return parsed
+    return None
+
+
+def multichip_trajectory_paths(root: str | None = None) -> list[str]:
+    root = root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return sorted(_glob.glob(os.path.join(root, "MULTICHIP_r*.json")))
+
+
+def multichip_schema_violations(current: dict) -> list[str]:
+    problems = []
+    for name in MULTICHIP_REQUIRED:
+        if name not in current:
+            problems.append(f"missing required fleet field {name!r}")
+        elif name == "per_worker_sigs":
+            if not isinstance(current[name], dict):
+                problems.append(f"{name} should be a dict, got "
+                                f"{type(current[name]).__name__}")
+        elif (isinstance(current[name], bool)
+              or not isinstance(current[name], (int, float))):
+            problems.append(f"{name} should be a number, got "
+                            f"{type(current[name]).__name__}")
+    return problems
+
+
+def fit_multichip_guards(trajectory: list[dict]) -> dict:
+    """Best-so-far guards over the parsed fleet entries (smoke and
+    pre-fleet empty-tail rounds contribute nothing)."""
+    guards: dict = {}
+    for run in trajectory:
+        if run is None or run.get("smoke"):
+            continue
+        for name, (direction, tol) in MULTICHIP_GUARDED.items():
+            v = run.get(name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+                continue
+            g = guards.get(name)
+            best = v if g is None else max(g["best"], v)
+            guards[name] = {"best": best, "bound": best * (1 - tol),
+                            "direction": direction, "tolerance": tol}
+    return guards
+
+
+def guard_multichip(current: dict,
+                    trajectory_paths: list[str] | None = None) -> list[str]:
+    """The fleet gate: schema always; value floors unless smoke. Used by
+    ``bench.py --fleet --guard`` and by the driver on the MULTICHIP
+    artifact."""
+    current = parse_multichip_artifact(current)
+    if current is None:
+        return ["artifact has no parsable fleet JSON in its tail"]
+    problems = multichip_schema_violations(current)
+    if current.get("smoke"):
+        return problems
+    paths = (multichip_trajectory_paths() if trajectory_paths is None
+             else trajectory_paths)
+    runs = []
+    for path in sorted(paths):
+        with open(path, encoding="utf-8") as f:
+            runs.append(parse_multichip_artifact(json.load(f)))
+    for name, g in sorted(fit_multichip_guards(runs).items()):
+        v = current.get(name)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if v < g["bound"]:
+            problems.append(
+                f"{name}: {v:g} < floor {g['bound']:.4g} "
+                f"(best {g['best']:g} - {g['tolerance']:.0%} tolerance; "
+                f"higher is better)")
+    return problems
+
+
 def guard_current(current: dict, trajectory_paths: list[str] | None = None
                   ) -> list[str]:
     """The bench.py --guard entry: fit guards from the repo trajectory and

@@ -51,6 +51,20 @@ def main(argv=None) -> int:
                         help="shard device batches over the first N local "
                              "chips (jax.sharding.Mesh; Verifier.kt's "
                              "scale-out seam, SPMD instead of N processes)")
+    parser.add_argument("--num-shards", type=int, default=None,
+                        help="fleet mode: split the visible devices into N "
+                             "contiguous shards; this worker takes shard "
+                             "--shard-index (run N workers, one per shard)")
+    parser.add_argument("--shard-index", type=int, default=0,
+                        help="which device shard this worker owns "
+                             "(with --num-shards)")
+    parser.add_argument("--capacity", type=int, default=None,
+                        help="advertised relative capacity (default: the "
+                             "shard's device count; the node router "
+                             "normalizes load estimates by it)")
+    parser.add_argument("--load-report-interval", type=float, default=0.5,
+                        help="seconds between WorkerLoadReports to the node "
+                             "router (0 disables)")
     parser.add_argument("--stats-file",
                         help="write batcher metrics JSON here on shutdown")
     parser.add_argument("--cordapp", action="append", default=None,
@@ -86,13 +100,33 @@ def main(argv=None) -> int:
     batcher_kwargs = {"use_device": not args.no_device}
     if args.host_crossover is not None:
         batcher_kwargs["host_crossover"] = args.host_crossover
+    device_shard: tuple = ()
+    if args.mesh_devices is not None and args.num_shards is not None:
+        parser.error("--mesh-devices and --num-shards are exclusive: a "
+                     "fleet worker owns a device shard, not the whole mesh")
     if args.mesh_devices is not None:
         from ..parallel import make_mesh
         batcher_kwargs["mesh"] = make_mesh(args.mesh_devices)
+    elif args.num_shards is not None and not args.no_device:
+        # fleet mode: this worker owns one contiguous shard of the visible
+        # devices — a private mesh when the shard has several chips, a
+        # plain device pin (no shard_map overhead) when it has one
+        from ..parallel import shard_devices
+        shard = shard_devices(args.num_shards)[args.shard_index]
+        device_shard = tuple(d.id for d in shard)
+        if len(shard) > 1:
+            from ..parallel import make_mesh
+            batcher_kwargs["mesh"] = make_mesh(devices=shard)
+        else:
+            batcher_kwargs["device"] = shard[0]
     batcher = SignatureBatcher(**batcher_kwargs)
-    worker = VerifierWorker(messaging, args.queue_address, batcher=batcher,
-                            use_device=not args.no_device,
-                            hello_interval_s=3.0)
+    worker = VerifierWorker(
+        messaging, args.queue_address, batcher=batcher,
+        use_device=not args.no_device,
+        hello_interval_s=3.0,
+        device_shard=device_shard, capacity=args.capacity,
+        load_report_interval_s=(args.load_report_interval
+                                if args.load_report_interval > 0 else None))
 
     print(f"VERIFIER READY {args.host}:{messaging.port}", flush=True)
 
@@ -109,6 +143,8 @@ def main(argv=None) -> int:
         snap = batcher.metrics.snapshot()
         with open(args.stats_file, "w") as f:
             json.dump({"verified_count": worker.verified_count,
+                       "processed_sig_count": worker.processed_sig_count,
+                       "device_shard": list(worker.device_shard),
                        "metrics": snap}, f)
     worker.stop()
     messaging.stop()

@@ -263,7 +263,7 @@ class SignatureBatcher:
 
     def __init__(self, max_batch: int = 32768, max_latency_s: float = 0.005,
                  metrics: MetricRegistry | None = None, use_device: bool = True,
-                 host_crossover: int = 192, mesh=None,
+                 host_crossover: int = 192, mesh=None, device=None,
                  breaker_threshold: int = 3, breaker_cooldown_s: float = 5.0,
                  breaker_clock=_time.monotonic,
                  interactive_latency_s: float = 0.002,
@@ -299,6 +299,14 @@ class SignatureBatcher:
         # a jax.sharding.Mesh shards every device batch over the local chips
         # (shard_map dp axis) — one node's batcher drives the whole slice
         self.mesh = mesh
+        # device-shard pinning (verifier fleet): a single jax.Device this
+        # batcher's dispatches run on, so N worker processes/batchers on one
+        # host each own a disjoint chip. Dispatch wraps jax.default_device
+        # (thread-local config — safe on the prep pool); mutually exclusive
+        # with mesh, which already owns explicit devices.
+        self.device = device
+        if mesh is not None and device is not None:
+            raise ValueError("pass mesh= or device=, not both")
         self._lock = threading.Condition()
         self._queues: dict[str, _SchemeQueue] = {
             "ed25519": _SchemeQueue(), "secp256k1": _SchemeQueue(),
@@ -350,6 +358,14 @@ class SignatureBatcher:
     def breaker_status(self) -> dict:
         """Per-scheme breaker state for /readyz and bench assertions."""
         return {name: b.status() for name, b in self._breakers.items()}
+
+    def queue_depths(self) -> dict:
+        """Per-scheme pending depth (signatures queued, not yet planned) —
+        the load snapshot the OOP worker ships to the node's router in its
+        WorkerLoadReport (same numbers as the SigBatcher.<name>.QueueDepth
+        gauges, one lock round)."""
+        with self._lock:
+            return {name: len(q) for name, q in self._queues.items()}
 
     # -- bucket ladder -------------------------------------------------------
     @staticmethod
@@ -801,9 +817,18 @@ class SignatureBatcher:
         t_prep = _time.perf_counter()
         mesh_verdicts = None
         breaker = self._breakers[bucket]
+        if self.device is not None:
+            # device-shard pin: uncommitted (numpy) kernel inputs follow the
+            # default device, so wrapping the launch places this batch on
+            # the worker's own chip (jax.default_device is thread-local —
+            # concurrent prep-pool dispatches don't leak across batchers)
+            import jax
+            pin_ctx = jax.default_device(self.device)
+        else:
+            pin_ctx = _null_ctx()
         try:
             with self.metrics.timer(f"SigBatcher.{bucket}.Prep"), \
-                    (profile_ctx or _null_ctx()):
+                    (profile_ctx or _null_ctx()), pin_ctx:
                 # chaos seam: a "raise" rule here exercises exactly the
                 # fallback + breaker path a real kernel failure would
                 fault_point("batcher.device_dispatch", detail=bucket)

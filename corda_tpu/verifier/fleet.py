@@ -1,0 +1,183 @@
+"""In-process verifier fleet: N device-sharded workers behind one queue.
+
+The MULTICHIP / ``bench.py --fleet`` harness. Everything rides the REAL
+out-of-process protocol — ``OutOfProcessTransactionVerifierService``'s
+load-aware router, ``VerifierWorker``'s stealable backlog, WorkerLoadReport
+/ StealRequest / WorkReturned — but over the deterministic in-memory bus
+with a background pump thread, so one process can measure fleet scaling
+without spawning N OS processes (the TCP plane and
+``python -m corda_tpu.verifier --num-shards`` are the production spelling
+of the same topology).
+
+Scaling efficiency is BUSY-TIME based, not count based::
+
+    efficiency = 100 × mean_i(last_completion_i − t0) / makespan
+
+i.e. how long each worker stayed busy relative to the whole run. A
+count-based definition (total / (n × max_per_worker)) would punish
+successful work stealing — stolen groups inflate the fast worker's count —
+while busy-time rewards exactly what the fleet is for: nobody idles while
+a straggler holds undone work.
+"""
+from __future__ import annotations
+
+import time
+import threading
+
+from ..core.crypto import generate_keypair
+from ..core.crypto.schemes import EDDSA_ED25519_SHA512
+from ..core.crypto.signatures import Crypto
+from ..network.inmemory import InMemoryMessagingNetwork
+from ..utils.metrics import MetricRegistry
+from .batcher import SignatureBatcher
+from .out_of_process import (OutOfProcessTransactionVerifierService,
+                             VerifierWorker)
+
+
+def make_sig_checks(n: int, unique: int = 16, seed: int = 7):
+    """Deterministic honestly-signed ed25519 ``(key, sig, content)`` checks,
+    ``unique`` distinct tiled to ``n`` (the bench corpus shape — signing is
+    pure Python, so uniqueness is bounded like bench.py's UNIQUE)."""
+    base = []
+    for i in range(min(n, unique)):
+        entropy = (seed * 1000003 + i).to_bytes(32, "little")
+        kp = generate_keypair(EDDSA_ED25519_SHA512, entropy=entropy)
+        content = (seed * 999331 + i).to_bytes(64, "little")
+        sig = Crypto.do_sign(kp.private, content, kp.public)
+        base.append((kp.public, sig, content))
+    return (base * (n // len(base) + 1))[:n]
+
+
+class InProcessFleet:
+    """N ``VerifierWorker``s (each with a private ``SignatureBatcher``,
+    optionally pinned to one jax device) attached to one node-side service,
+    all on an in-memory bus pumped by a background thread.
+
+    ``report_every_s`` drives ``send_load_report`` from the pump thread —
+    the load/steal machinery stays live without per-worker timer threads,
+    and the pump delivers the reports in the same loop."""
+
+    def __init__(self, n_workers: int, use_device: bool = False,
+                 devices=None, host_crossover: int | None = None,
+                 max_latency_s: float = 0.005,
+                 max_inflight_groups: int | None = 2,
+                 report_every_s: float = 0.01,
+                 metrics: MetricRegistry | None = None):
+        if n_workers < 1:
+            raise ValueError("a fleet needs at least one worker")
+        if devices is not None and len(devices) < n_workers:
+            raise ValueError(f"{n_workers} workers but only "
+                             f"{len(devices)} devices")
+        self.n_workers = n_workers
+        self.metrics = metrics if metrics is not None else MetricRegistry()
+        self.bus = InMemoryMessagingNetwork()
+        self.service = OutOfProcessTransactionVerifierService(
+            self.bus.create_node("node"), metrics=self.metrics,
+            expected_workers=n_workers)
+        batcher_kwargs: dict = {"use_device": use_device,
+                                "max_latency_s": max_latency_s}
+        if host_crossover is not None:
+            batcher_kwargs["host_crossover"] = host_crossover
+        self.batchers: list[SignatureBatcher] = []
+        self.workers: list[VerifierWorker] = []
+        for i in range(n_workers):
+            kwargs = dict(batcher_kwargs)
+            shard: tuple = ()
+            if devices is not None:
+                kwargs["device"] = devices[i]
+                shard = (getattr(devices[i], "id", i),)
+            batcher = SignatureBatcher(**kwargs)
+            worker = VerifierWorker(
+                self.bus.create_node(f"w{i}"), "node",
+                batcher=batcher, use_device=use_device,
+                device_shard=shard, capacity=1,
+                load_report_interval_s=None,   # pump thread reports instead
+                max_inflight_groups=max_inflight_groups)
+            worker._report_enabled = True      # idle pings feed the stealer
+            self.batchers.append(batcher)
+            self.workers.append(worker)
+        self._report_every_s = report_every_s
+        self._stop = threading.Event()
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True,
+                                      name="fleet-pump")
+        self._pump.start()
+
+    def _pump_loop(self) -> None:
+        last_report = 0.0
+        while not self._stop.is_set():
+            progressed = self.bus.run_network()
+            now = time.monotonic()
+            if now - last_report >= self._report_every_s:
+                last_report = now
+                for w in self.workers:
+                    try:
+                        w.send_load_report()
+                    except Exception:
+                        pass   # a stopped worker mid-close; pump survives
+            if not progressed:
+                time.sleep(0.0005)
+
+    def verify_signatures(self, checks):
+        return self.service.verify_signatures(checks)
+
+    def steal_count(self) -> int:
+        return self.metrics.meter("Fleet.Steals").count
+
+    def stolen_count(self) -> int:
+        return self.metrics.meter("Fleet.Stolen").count
+
+    def close(self) -> None:
+        self._stop.set()
+        self._pump.join(timeout=5.0)
+        for w in self.workers:
+            try:
+                w.stop(announce=False)
+            except Exception:
+                pass
+        for b in self.batchers:
+            b.close()
+        self.service.shutdown()
+
+
+def fleet_bench(n_workers: int, groups: int = 64, group_size: int = 16,
+                use_device: bool = False, devices=None,
+                host_crossover: int | None = None,
+                max_inflight_groups: int | None = 2,
+                unique: int = 16, timeout_s: float = 600.0) -> dict:
+    """Run ``groups`` signature groups of ``group_size`` ed25519 checks
+    through an N-worker fleet and measure aggregate throughput + busy-time
+    scaling efficiency. Returns the MULTICHIP artifact fields."""
+    fleet = InProcessFleet(
+        n_workers, use_device=use_device, devices=devices,
+        host_crossover=host_crossover,
+        max_inflight_groups=max_inflight_groups)
+    try:
+        checks = make_sig_checks(group_size, unique=unique)
+        # warm the path (and, on device, the compile) before timing
+        fleet.verify_signatures(checks).result(timeout=timeout_s)
+        t0 = time.monotonic()
+        futures = [fleet.verify_signatures(checks) for _ in range(groups)]
+        for f in futures:
+            f.result(timeout=timeout_s)
+        makespan = time.monotonic() - t0
+        total = groups * group_size
+        busy = [max(0.0, (w.last_completion_t or t0) - t0)
+                for w in fleet.workers]
+        efficiency = (100.0 * (sum(busy) / len(busy)) / makespan
+                      if makespan > 0 else 0.0)
+        per_worker = {w.network_service.my_address: w.processed_sig_count
+                      for w in fleet.workers}
+        return {
+            "fleet_verifies_per_sec": round(total / makespan, 1),
+            "scaling_efficiency_pct": round(min(100.0, efficiency), 1),
+            "n_workers": n_workers,
+            "n_devices": len(devices) if devices is not None else 0,
+            "fleet_steals": fleet.steal_count(),
+            "fleet_stolen": fleet.stolen_count(),
+            "groups": groups,
+            "group_size": group_size,
+            "wall_s": round(makespan, 4),
+            "per_worker_sigs": per_worker,
+        }
+    finally:
+        fleet.close()

@@ -12,9 +12,15 @@ Reference parity:
   (NodeMessagingClient.kt:200-210)
 
 The queue semantics live in `VerifierRequestQueue` (the Artemis
-`verifier.requests` queue analog): work is dealt round-robin to attached
-workers, outstanding work is tracked per worker, and a worker's detachment
-requeues everything it held. Transport-independent — the deterministic
+`verifier.requests` queue analog): work is dealt to attached workers by a
+load-aware router (live queue depth from periodic worker load reports +
+scheme affinity, round-robin tie-break), outstanding work is tracked per
+worker, and a worker's detachment requeues everything it held. An idle
+worker triggers WORK STEALING: the node asks the deepest straggler to hand
+back the tail of its stealable backlog (WorkReturned) and re-deals it —
+exactly-once future resolution is preserved because a returned request is
+re-dealt only while still charged to the victim, and duplicate responses
+find their handle already popped. Transport-independent — the deterministic
 in-memory bus in tests, the TCP plane in production.
 """
 from __future__ import annotations
@@ -23,6 +29,7 @@ import itertools
 import logging
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Any
@@ -64,9 +71,17 @@ class VerificationResponse:
 
 @dataclass(frozen=True)
 class WorkerHello:
-    """A worker attaching to the queue (the Artemis consumer-creation analog)."""
+    """A worker attaching to the queue (the Artemis consumer-creation analog).
+
+    ``device_shard`` carries the jax device ids this worker's batcher is
+    pinned to and ``capacity`` its relative weight (≈ devices in the shard)
+    — the router normalizes estimated load by capacity, and both surface as
+    per-worker ``Fleet.*`` gauges on /metrics. Defaults keep pre-fleet
+    hellos deserializing."""
 
     worker_address: str
+    device_shard: tuple = ()    # jax device ids, () = host-only / unpinned
+    capacity: int = 1
 
 
 @dataclass(frozen=True)
@@ -74,9 +89,63 @@ class WorkerGoodbye:
     worker_address: str
 
 
+@dataclass(frozen=True)
+class WorkerLoadReport:
+    """Periodic worker → node load report (the PR 2 batcher gauges shipped
+    back over the worker wire): ``pending`` is the stealable backlog weight
+    in signatures, ``in_flight`` the signatures submitted to the batcher but
+    unresolved, ``queue_depths`` the per-scheme batcher depths (affinity
+    signal). A report is also a liveness signal (_last_activity)."""
+
+    worker_address: str
+    pending: int
+    in_flight: int
+    queue_depths: tuple = ()    # ((scheme, depth), ...)
+    capacity: int = 1
+
+
+@dataclass(frozen=True)
+class StealRequest:
+    """Node → straggler: hand back up to ``max_items`` requests from the
+    tail of your stealable backlog (``thief_address`` is informational —
+    the node re-deals through the router, it does not promise the thief)."""
+
+    thief_address: str
+    max_items: int
+
+
+@dataclass(frozen=True)
+class WorkReturned:
+    """Straggler → node: the stolen requests (possibly empty — an empty
+    return still acks the StealRequest and clears the in-flight marker)."""
+
+    worker_address: str
+    requests: tuple = ()
+
+
 for _cls in (VerificationRequest, VerificationResponse, WorkerHello,
-             WorkerGoodbye):
+             WorkerGoodbye, WorkerLoadReport, StealRequest, WorkReturned):
     register_type(f"verifier.{_cls.__name__}", _cls)
+
+
+def _weight(req: VerificationRequest) -> int:
+    """Routing weight of one request: its signature count (≥ 1 — an
+    ltx-only request still occupies the worker's host path)."""
+    return max(1, len(req.signatures))
+
+
+def _dominant_bucket(signatures) -> str | None:
+    """The batcher bucket most of a request's signatures route to — the
+    scheme-affinity token the router compares against the worker's last
+    dealt bucket (same vocabulary as SigBatcher.<name>.* gauges)."""
+    if not signatures:
+        return None
+    from .batcher import _BUCKETS
+    counts: dict[str, int] = {}
+    for key, _sig, _content in signatures:
+        b = _BUCKETS.get(key.scheme.scheme_number_id, "host")
+        counts[b] = counts.get(b, 0) + 1
+    return max(counts, key=counts.get)
 
 
 class VerifierRequestQueue:
@@ -90,9 +159,27 @@ class VerifierRequestQueue:
     request outstanding longer than the timeout declares its worker dead and
     requeues everything it held."""
 
-    def __init__(self, network_service, redelivery_timeout_s: float | None = None):
+    #: Router slack (capacity-normalized signature weight): workers within
+    #: this much of the least-loaded worker stay candidates, so light loads
+    #: keep the old round-robin fairness and affinity has room to act.
+    ROUTE_SLACK = 4.0
+    #: Minimum reported stealable backlog (signatures) before the node asks
+    #: a straggler to hand work back — below this a steal round-trip costs
+    #: more than it saves.
+    STEAL_MIN_WEIGHT = 4
+    #: Max requests one StealRequest may pull (the worker additionally caps
+    #: at half its backlog, so a steal can never starve the victim).
+    STEAL_MAX_ITEMS = 64
+    #: A StealRequest with no WorkReturned after this long is forgotten —
+    #: the victim crashed (detach requeues its work anyway) or the ack got
+    #: lost; either way the victim becomes stealable again.
+    STEAL_TIMEOUT_S = 2.0
+
+    def __init__(self, network_service, redelivery_timeout_s: float | None = None,
+                 metrics: MetricRegistry | None = None):
         self.network_service = network_service
         self.redelivery_timeout_s = redelivery_timeout_s
+        self.metrics = metrics if metrics is not None else MetricRegistry()
         self._lock = threading.RLock()
         self._workers: list[str] = []
         self._rr = 0
@@ -100,6 +187,17 @@ class VerifierRequestQueue:
         self._outstanding: dict[str, list[VerificationRequest]] = {}
         self._dealt_at: dict[int, tuple[str, float]] = {}  # vid -> (worker, t)
         self._last_activity: dict[str, float] = {}         # worker -> t
+        # fleet state: per-worker shard/capacity from the hello, latest load
+        # report (+ node arrival time), last-dealt scheme bucket (affinity),
+        # and in-flight StealRequests (one per victim at a time)
+        self._shards: dict[str, tuple] = {}
+        self._capacity: dict[str, int] = {}
+        self._reports: dict[str, tuple[WorkerLoadReport, float]] = {}
+        self._affinity: dict[str, str] = {}
+        self._steal_inflight: dict[str, float] = {}
+        self._gauged: set[str] = set()
+        self.metrics.gauge("Fleet.WorkersAttached",
+                           lambda: len(self._workers))
         network_service.add_message_handler(
             TopicSession(TOPIC_VERIFIER_REQUESTS), self._on_control)
 
@@ -112,9 +210,40 @@ class VerifierRequestQueue:
                     self._workers.append(payload.worker_address)
                     self._outstanding.setdefault(payload.worker_address, [])
                 self._last_activity[payload.worker_address] = time.monotonic()
+                self._shards[payload.worker_address] = \
+                    tuple(payload.device_shard)
+                self._capacity[payload.worker_address] = \
+                    max(1, int(payload.capacity))
+                self._register_worker_gauges(payload.worker_address)
             self._drain()
         elif isinstance(payload, WorkerGoodbye):
             self.detach_worker(payload.worker_address)
+        elif isinstance(payload, WorkerLoadReport):
+            self._on_load_report(payload)
+        elif isinstance(payload, WorkReturned):
+            self._on_work_returned(payload)
+
+    def _register_worker_gauges(self, worker: str) -> None:
+        """Per-worker fleet gauges on /metrics (CALLER HOLDS THE LOCK).
+        Registration is idempotent; a detached worker's gauges read 0
+        (capacity is popped on detach) rather than disappearing."""
+        if worker in self._gauged:
+            return
+        self._gauged.add(worker)
+        self.metrics.gauge(
+            f"Fleet.WorkerCapacity.{worker}",
+            lambda w=worker: self._capacity.get(w, 0))
+        self.metrics.gauge(
+            f"Fleet.WorkerQueueDepth.{worker}",
+            lambda w=worker: self._queue_depth_of(w))
+
+    def _queue_depth_of(self, worker: str) -> int:
+        """Raw (un-normalized) estimated signature depth of one worker."""
+        with self._lock:
+            if worker not in self._workers:
+                return 0
+            return int(self._est_load_locked(worker, time.monotonic())
+                       * self._capacity.get(worker, 1))
 
     def detach_worker(self, worker: str) -> None:
         """Worker death: requeue everything it held (broker redelivery)."""
@@ -128,7 +257,139 @@ class VerifierRequestQueue:
                 log.info("requeueing %d verifications from dead worker %s",
                          len(held), worker)
             self._pending = held + self._pending
+            self._reports.pop(worker, None)
+            self._capacity.pop(worker, None)
+            self._shards.pop(worker, None)
+            self._affinity.pop(worker, None)
+            self._steal_inflight.pop(worker, None)
         self._drain()
+
+    # -- load reports + work stealing ----------------------------------------
+    def _on_load_report(self, report: WorkerLoadReport) -> None:
+        with self._lock:
+            worker = report.worker_address
+            if worker not in self._workers:
+                return   # detached (or never attached): its re-hello re-joins
+            now = time.monotonic()
+            self._reports[worker] = (report, now)
+            self._last_activity[worker] = now
+            if report.capacity:
+                self._capacity[worker] = max(1, int(report.capacity))
+        # a newly idle worker can take pending work right away — and may
+        # justify stealing from a straggler's backlog
+        self._drain()
+        self._maybe_steal()
+
+    def _on_work_returned(self, ret: WorkReturned) -> None:
+        """Stolen work coming back from a straggler. Re-deal ONLY requests
+        still charged to the victim in _dealt_at — a request the overdue
+        scan already requeued (steal racing a requeue) has a live copy
+        elsewhere, and re-dealing the stale return would double-verify it
+        (harmless for the future — _on_response pops the handle — but a
+        wasted batch slot)."""
+        victim = ret.worker_address
+        with self._lock:
+            self._steal_inflight.pop(victim, None)
+            self._last_activity[victim] = time.monotonic()
+            requeued = []
+            still_held = self._outstanding.get(victim)
+            for req in ret.requests:
+                owner, _t = self._dealt_at.get(req.verification_id,
+                                               (None, 0.0))
+                if owner != victim or still_held is None:
+                    continue
+                del self._dealt_at[req.verification_id]
+                still_held[:] = [r for r in still_held
+                                 if r.verification_id != req.verification_id]
+                requeued.append(req)
+            self._pending = requeued + self._pending
+        if requeued:
+            self.metrics.meter("Fleet.Stolen").mark(len(requeued))
+        self._drain()
+
+    def _maybe_steal(self) -> None:
+        """If some worker sits idle while another holds a deep stealable
+        backlog, ask the straggler to hand back its tail. One StealRequest
+        in flight per victim; the send itself rides the crash-detach path
+        (a dead victim's work requeues via detach, not via the steal)."""
+        with self._lock:
+            if len(self._workers) < 2:
+                return
+            now = time.monotonic()
+            for v, t in list(self._steal_inflight.items()):
+                if now - t > self.STEAL_TIMEOUT_S:
+                    del self._steal_inflight[v]
+            idle = [w for w in self._workers
+                    if self._est_load_locked(w, now) <= 0.0]
+            if not idle:
+                return
+            victim, backlog = None, 0
+            for w in self._workers:
+                if w in idle or w in self._steal_inflight:
+                    continue
+                rep = self._reports.get(w)
+                stealable = rep[0].pending if rep is not None else 0
+                if stealable > backlog:
+                    victim, backlog = w, stealable
+            if victim is None or backlog < self.STEAL_MIN_WEIGHT:
+                return
+            self._steal_inflight[victim] = now
+            thief = idle[0]
+        self.metrics.meter("Fleet.Steals").mark()
+        try:
+            if fault_point("oop.deliver", detail=f"->{victim}") == DROP:
+                return   # lost steal: the timeout forgets it
+            self.network_service.send(
+                TopicSession(TOPIC_VERIFIER_REQUESTS),
+                serialize(StealRequest(thief, self.STEAL_MAX_ITEMS)), victim)
+        except Exception:
+            log.warning("steal request to verifier %s failed; detaching",
+                        victim, exc_info=True)
+            self.detach_worker(victim)
+
+    # -- load-aware routing --------------------------------------------------
+    def _est_load_locked(self, worker: str, now: float) -> float:
+        """Estimated queue depth of one worker, normalized by its capacity:
+        the last load report's (pending + in-flight) signatures, plus the
+        weight of everything dealt to it SINCE that report arrived (the
+        report already accounts for earlier deals). No report yet → the
+        full outstanding weight."""
+        rep = self._reports.get(worker)
+        if rep is None:
+            base, since = 0, 0.0
+        else:
+            report, t_rep = rep
+            base, since = report.pending + report.in_flight, t_rep
+        dealt = sum(_weight(r) for r in self._outstanding.get(worker, ())
+                    if self._dealt_at.get(r.verification_id,
+                                          (None, 0.0))[1] > since)
+        return (base + dealt) / max(1, self._capacity.get(worker, 1))
+
+    def _pick_worker_locked(self, req: VerificationRequest,
+                            now: float) -> str:
+        """The router: workers within ROUTE_SLACK of the least estimated
+        load are candidates; among candidates, prefer the ones whose last
+        dealt bucket matches this request's dominant scheme (a warm batcher
+        queue coalesces same-scheme groups into fuller device batches);
+        round-robin breaks the remaining tie so light load keeps the old
+        fair dealing."""
+        if len(self._workers) == 1:
+            return self._workers[0]
+        loads = {w: self._est_load_locked(w, now) for w in self._workers}
+        best = min(loads.values())
+        slack = max(self.ROUTE_SLACK, best * 0.25)
+        candidates = [w for w in self._workers if loads[w] <= best + slack]
+        bucket = _dominant_bucket(req.signatures)
+        if bucket is not None:
+            affine = [w for w in candidates
+                      if self._affinity.get(w) == bucket]
+            if affine:
+                candidates = affine
+        pick = candidates[self._rr % len(candidates)]
+        self._rr += 1
+        if bucket is not None:
+            self._affinity[pick] = bucket
+        return pick
 
     def requeue_overdue(self) -> None:
         """Declare dead any worker that is BOTH holding a request past the
@@ -180,8 +441,7 @@ class VerifierRequestQueue:
                 if not self._pending or not self._workers:
                     return
                 req = self._pending.pop(0)
-                worker = self._workers[self._rr % len(self._workers)]
-                self._rr += 1
+                worker = self._pick_worker_locked(req, time.monotonic())
                 self._outstanding[worker].append(req)
                 self._dealt_at[req.verification_id] = (worker,
                                                        time.monotonic())
@@ -210,11 +470,16 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
     duration/success/failure/in-flight metrics, response consumer)."""
 
     def __init__(self, network_service, metrics: MetricRegistry | None = None,
-                 redelivery_timeout_s: float | None = None):
+                 redelivery_timeout_s: float | None = None,
+                 expected_workers: int | None = None):
         self.metrics = metrics if metrics is not None else MetricRegistry()
         self.network_service = network_service
+        # expected fleet size (config): /readyz compares attached against it
+        # and reports a partial fleet as degraded (fleet_status)
+        self.expected_workers = expected_workers
         self.queue = VerifierRequestQueue(
-            network_service, redelivery_timeout_s=redelivery_timeout_s)
+            network_service, redelivery_timeout_s=redelivery_timeout_s,
+            metrics=self.metrics)
         self._ids = itertools.count(1)
         self._handles: dict[int, Future] = {}
         self._timers: dict[int, object] = {}
@@ -253,6 +518,33 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
 
     def shutdown(self) -> None:
         self._stopping.set()
+
+    def fleet_status(self) -> dict:
+        """Fleet membership + per-worker load for /readyz: attached vs
+        expected, and each worker's shard / capacity / estimated depth."""
+        q = self.queue
+        with q._lock:
+            workers = {
+                w: {"device_shard": list(q._shards.get(w, ())),
+                    "capacity": q._capacity.get(w, 1),
+                    "queue_depth": q._queue_depth_of(w)}
+                for w in q._workers}
+        out = {"expected": self.expected_workers, "attached": len(workers),
+               "workers": workers}
+        out["degraded"] = (self.expected_workers is not None
+                           and len(workers) < self.expected_workers)
+        return out
+
+    def verify_signatures(self, checks) -> Future:
+        """Bulk signature-group verification through the fleet: one future
+        resolving when every (key, sig, content) check of the group passed
+        (None) or with the first failure's message. The request carries no
+        transaction — the worker runs only the EC math through its batcher
+        (the fleet bench / bulk-backlog path; verify_signed for full
+        SignedTransaction semantics)."""
+        sigs = tuple((key, sig, content) for key, sig, content in checks)
+        return self._submit(VerificationRequest(
+            next(self._ids), None, self.network_service.my_address, sigs))
 
     def verify(self, ltx) -> Future:
         return self._submit(VerificationRequest(
@@ -322,21 +614,41 @@ class VerifierWorker:
 
     Device path (VERDICT r2 #1): requests carrying ``signatures`` run their
     EC checks through this worker's ``SignatureBatcher`` — the message
-    handler only *submits* to the batcher and hands completion to a small
-    thread pool, so consecutive requests' signatures coalesce into one
-    device batch (cross-transaction batching inside the worker, the whole
-    point of putting a TPU behind the competing-consumer queue). Requests
-    without signatures keep the reference's synchronous host semantics
-    (deterministic for the manually-pumped test bus)."""
+    handler parks them on a STEALABLE BACKLOG and a feeder admits at most
+    ``max_inflight_groups`` groups into the batcher at a time, so
+    consecutive requests' signatures still coalesce into one device batch
+    while everything beyond the in-flight window stays reclaimable: a
+    StealRequest pops the backlog's tail (LIFO — the feeder drains the
+    head) and hands it back to the node for re-dealing. The default
+    ``max_inflight_groups=None`` disables the holdback (everything goes
+    straight to the batcher, preserving the pre-fleet batch shapes and
+    their compile-cache hits); fleet deployments set a finite window so a
+    straggler keeps a stealable tail. Requests without signatures keep the
+    reference's synchronous host semantics (deterministic for the
+    manually-pumped test bus)."""
 
     def __init__(self, network_service, queue_address: str,
                  batcher=None, use_device: bool = True, pool_workers: int = 4,
-                 hello_interval_s: float | None = None):
+                 hello_interval_s: float | None = None,
+                 device_shard: tuple = (), capacity: int | None = None,
+                 load_report_interval_s: float | None = None,
+                 max_inflight_groups: int | None = None):
         self.network_service = network_service
         self.queue_address = queue_address
         self.verified_count = 0
+        self.processed_sig_count = 0   # signatures through the batcher
+        self.last_completion_t = None  # monotonic t of last device group
         self._count_lock = threading.Lock()
         self.use_device = use_device
+        self.device_shard = tuple(device_shard)
+        self.capacity = (capacity if capacity is not None
+                         else max(1, len(self.device_shard)))
+        self.max_inflight_groups = max_inflight_groups
+        self._backlog: "deque[VerificationRequest]" = deque()
+        self._backlog_lock = threading.Lock()
+        self._inflight_groups = 0
+        self._inflight_sigs = 0
+        self._report_enabled = load_report_interval_s is not None
         self._batcher = batcher            # created lazily if None
         self._pool = None
         self._registration = network_service.add_message_handler(
@@ -361,16 +673,50 @@ class VerifierWorker:
                                         self.queue_address, exc_info=True)
             threading.Thread(target=_rehello, daemon=True,
                              name="verifier-hello").start()
+        if load_report_interval_s is not None:
+            def _report_loop():
+                while self._alive:
+                    time.sleep(load_report_interval_s)
+                    if self._alive:
+                        try:
+                            self.send_load_report()
+                        except Exception:
+                            log.warning("load report to %s failed",
+                                        self.queue_address, exc_info=True)
+            threading.Thread(target=_report_loop, daemon=True,
+                             name="verifier-load-report").start()
 
     def _hello(self) -> None:
         retry.retry_call(
             lambda: self.network_service.send(
                 TopicSession(TOPIC_VERIFIER_REQUESTS),
-                serialize(WorkerHello(self.network_service.my_address)),
+                serialize(WorkerHello(self.network_service.my_address,
+                                      self.device_shard, self.capacity)),
                 self.queue_address),
             site="oop.hello",
             policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5, max_attempts=4),
             retry_on=(OSError, ConnectionError, LookupError))
+
+    def send_load_report(self) -> None:
+        """Ship the live load picture to the node's router: stealable
+        backlog weight + batcher in-flight signatures + the per-scheme
+        queue-depth gauges. Called on the report interval, on going idle,
+        and by hand from deterministic tests."""
+        with self._backlog_lock:
+            pending = sum(_weight(r) for r in self._backlog)
+            in_flight = self._inflight_sigs
+        depths: tuple = ()
+        if self._batcher is not None:
+            try:
+                depths = tuple(sorted(self._batcher.queue_depths().items()))
+            except Exception:
+                depths = ()
+        self.network_service.send(
+            TopicSession(TOPIC_VERIFIER_REQUESTS),
+            serialize(WorkerLoadReport(
+                self.network_service.my_address, pending, in_flight,
+                depths, self.capacity)),
+            self.queue_address)
 
     @property
     def batcher(self):
@@ -382,20 +728,76 @@ class VerifierWorker:
     def _on_request(self, msg) -> None:
         if not self._alive:
             return
-        req: VerificationRequest = deserialize(msg.data)
+        payload = deserialize(msg.data)
+        if isinstance(payload, StealRequest):
+            self._on_steal(payload)
+            return
+        req: VerificationRequest = payload
         if not req.signatures:
             self._reply(req, self._verify_host(req))
             return
-        # device path: queue the EC math now (non-blocking), finish async
-        group_future = self.batcher.submit_group(req.signatures)
-        if self._pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            self._pool = ThreadPoolExecutor(
-                max_workers=self._pool_workers,
-                thread_name_prefix="verifier-worker")
-        self._pool.submit(self._complete_device, req, group_future)
+        # device path: park on the stealable backlog; the feeder admits up
+        # to max_inflight_groups into the batcher (non-blocking)
+        with self._backlog_lock:
+            self._backlog.append(req)
+        self._feed()
+
+    def _feed(self) -> None:
+        """Admit backlog head-first into the batcher while the in-flight
+        window has room. Everything still on the backlog is stealable."""
+        while True:
+            with self._backlog_lock:
+                if (not self._backlog
+                        or (self.max_inflight_groups is not None
+                            and self._inflight_groups
+                            >= self.max_inflight_groups)):
+                    return
+                req = self._backlog.popleft()
+                self._inflight_groups += 1
+                self._inflight_sigs += len(req.signatures)
+            try:
+                group_future = self.batcher.submit_group(req.signatures)
+            except Exception as e:
+                with self._backlog_lock:
+                    self._inflight_groups -= 1
+                    self._inflight_sigs -= len(req.signatures)
+                self._reply(req, str(e))
+                continue
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._pool_workers,
+                    thread_name_prefix="verifier-worker")
+            self._pool.submit(self._complete_device, req, group_future)
+
+    def _on_steal(self, steal: StealRequest) -> None:
+        """Hand the backlog's TAIL back to the node (the feeder eats the
+        head — LIFO stealing keeps the oldest work local where its scheme
+        affinity already warmed the batcher). At most half the backlog goes;
+        an empty return still acks the steal."""
+        taken: list[VerificationRequest] = []
+        with self._backlog_lock:
+            limit = min(steal.max_items, (len(self._backlog) + 1) // 2)
+            for _ in range(limit):
+                taken.append(self._backlog.pop())
+        taken.reverse()
+        try:
+            self.network_service.send(
+                TopicSession(TOPIC_VERIFIER_REQUESTS),
+                serialize(WorkReturned(self.network_service.my_address,
+                                       tuple(taken))),
+                self.queue_address)
+        except Exception:
+            # the node link died mid-steal: keep the work — our requests are
+            # still charged to us, so the node's detach path re-deals them
+            with self._backlog_lock:
+                self._backlog.extendleft(reversed(taken))
+            log.warning("returning stolen work to %s failed",
+                        self.queue_address, exc_info=True)
 
     def _verify_host(self, req: VerificationRequest) -> str | None:
+        if req.transaction is None:
+            return None   # pure signature group (verify_signatures)
         try:
             req.transaction.verify()
             return None
@@ -417,6 +819,23 @@ class VerifierWorker:
         except Exception as e:
             error = str(e)
         self._reply(req, error)
+        with self._backlog_lock:
+            self._inflight_groups -= 1
+            self._inflight_sigs -= len(req.signatures)
+            self.processed_sig_count += len(req.signatures)
+            # busy-time marker: the fleet bench's scaling-efficiency metric
+            # is mean(last_completion - t0) / makespan across workers
+            self.last_completion_t = time.monotonic()
+        self._feed()
+        with self._backlog_lock:
+            idle = not self._backlog and self._inflight_groups == 0
+        if idle and self._report_enabled and self._alive:
+            # immediate idle ping: the router learns this worker drained
+            # without waiting out the report interval — the steal trigger
+            try:
+                self.send_load_report()
+            except Exception:
+                log.warning("idle load report failed", exc_info=True)
 
     def _reply(self, req: VerificationRequest, error: str | None) -> None:
         if not self._alive:

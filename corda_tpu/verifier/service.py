@@ -180,7 +180,7 @@ class TpuTransactionVerifierService(TransactionVerifierService):
 def make_verifier_service(verifier_type: str = "InMemory", **kwargs
                           ) -> TransactionVerifierService:
     """The VerifierType config seam (NodeConfiguration.kt:91-94):
-    "InMemory" | "Tpu" ("OutOfProcess" arrives with the messaging layer).
+    "InMemory" | "Tpu" | "OutOfProcess".
 
     NOTE on the Tpu backend: only ``verify_signed(stx, ...)`` pays off on
     device — the reference-shaped ``verify(ltx)`` SPI verifies contract and
@@ -188,9 +188,16 @@ def make_verifier_service(verifier_type: str = "InMemory", **kwargs
     it exists), so callers holding a SignedTransaction should use
     ``verify_signed``. The node's flow path does (the SMM's Verify
     suspension point routes through verify_signed; locked by
-    tests/test_verify_suspension.py's device-batch assertion)."""
+    tests/test_verify_suspension.py's device-batch assertion).
+
+    "OutOfProcess" needs ``network_service=`` (the node's messaging — the
+    queue the worker fleet attaches to); ``expected_workers=`` sizes the
+    fleet for /readyz degradation reporting."""
     if verifier_type == "InMemory":
         return InMemoryTransactionVerifierService(**kwargs)
     if verifier_type == "Tpu":
         return TpuTransactionVerifierService(**kwargs)
+    if verifier_type == "OutOfProcess":
+        from .out_of_process import OutOfProcessTransactionVerifierService
+        return OutOfProcessTransactionVerifierService(**kwargs)
     raise ValueError(f"Unknown verifier type: {verifier_type}")

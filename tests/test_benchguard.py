@@ -118,3 +118,69 @@ def test_guard_current_with_explicit_paths(tmp_path):
     problems = benchguard.guard_current(_artifact(value=100.0), [str(p)])
     assert any("value: 100" in x for x in problems)
     assert benchguard.guard_current(_artifact(value=190.0), [str(p)]) == []
+
+
+# ---------------------------------------------------------------------------
+# MULTICHIP (fleet) gate
+# ---------------------------------------------------------------------------
+
+def _fleet(**over):
+    base = {
+        "fleet_verifies_per_sec": 50000.0,
+        "scaling_efficiency_pct": 92.0,
+        "n_workers": 8, "n_devices": 8,
+        "fleet_steals": 3, "fleet_stolen": 12,
+        "per_worker_sigs": {"w0": 4096, "w1": 4096},
+    }
+    base.update(over)
+    return base
+
+
+def test_multichip_tail_parsed_from_last_json_line():
+    """The fleet stage prints its JSON LAST; earlier stdout lines (even
+    JSON-looking ones without the fleet fields) must not win."""
+    tail = ('some dry-run chatter\n{"not": "the fleet line"}\n'
+            + json.dumps(_fleet(fleet_verifies_per_sec=1234.5)) + "\n")
+    parsed = benchguard.parse_multichip_artifact(
+        {"n_devices": 8, "rc": 0, "ok": True, "tail": tail})
+    assert parsed is not None
+    assert parsed["fleet_verifies_per_sec"] == 1234.5
+
+
+def test_multichip_empty_tail_is_pre_fleet():
+    assert benchguard.parse_multichip_artifact(
+        {"n_devices": 8, "rc": 0, "ok": True, "tail": ""}) is None
+
+
+def test_multichip_regression_fails_against_trajectory(tmp_path):
+    p = tmp_path / "MULTICHIP_r06.json"
+    p.write_text(json.dumps(
+        {"n_devices": 8, "rc": 0, "ok": True,
+         "tail": json.dumps(_fleet()) + "\n"}))
+    # floors: 50000*0.85=42500 and 92*0.85=78.2
+    bad_rate = benchguard.guard_multichip(
+        _fleet(fleet_verifies_per_sec=40000.0), [str(p)])
+    assert any("fleet_verifies_per_sec" in x and "floor" in x
+               for x in bad_rate)
+    bad_eff = benchguard.guard_multichip(
+        _fleet(scaling_efficiency_pct=70.0), [str(p)])
+    assert any("scaling_efficiency_pct" in x for x in bad_eff)
+    assert benchguard.guard_multichip(_fleet(), [str(p)]) == []
+
+
+def test_multichip_smoke_schema_only():
+    smoke = _fleet(fleet_verifies_per_sec=3.0, smoke=True)
+    assert benchguard.guard_multichip(smoke, []) == []
+    broken = dict(smoke)
+    del broken["scaling_efficiency_pct"]
+    problems = benchguard.guard_multichip(broken, [])
+    assert any("scaling_efficiency_pct" in p for p in problems)
+
+
+def test_multichip_real_trajectory_accepts_historical_artifacts():
+    """Pre-fleet rounds have empty tails: they contribute nothing to the
+    guards and must not crash the fit."""
+    paths = benchguard.multichip_trajectory_paths()
+    if not paths:
+        pytest.skip("no MULTICHIP_r*.json artifacts in this checkout")
+    assert benchguard.guard_multichip(_fleet(), paths) == []

@@ -1,0 +1,38 @@
+"""bench.py --smoke --fleet: the in-process fleet wiring check for tier-1.
+
+Two host-route workers behind the out-of-process queue's load-aware
+router must both receive and complete work, every future must resolve,
+and the one-line JSON aggregate must carry the MULTICHIP artifact fields
+(fleet_verifies_per_sec / scaling_efficiency_pct / n_workers) that
+tools/benchguard.py locks on device runs.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fleet_smoke_two_workers_share_the_run():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--fleet"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    for field in ("fleet_verifies_per_sec", "scaling_efficiency_pct",
+                  "n_workers", "n_devices", "fleet_steals", "fleet_stolen",
+                  "groups", "group_size", "wall_s", "per_worker_sigs"):
+        assert field in out, f"missing fleet JSON field: {field}"
+    assert out["smoke"] is True and out["fleet"] is True
+    assert out["n_workers"] == 2
+    assert out["fleet_verifies_per_sec"] > 0
+    assert 0 < out["scaling_efficiency_pct"] <= 100
+    # the router dealt to BOTH workers and both did real work — a fleet
+    # where one worker starves is the regression this test exists to catch
+    sigs = out["per_worker_sigs"]
+    assert len(sigs) == 2 and all(c > 0 for c in sigs.values()), sigs
+    # timed groups + the warm-up group all landed somewhere
+    assert sum(sigs.values()) == (out["groups"] + 1) * out["group_size"]

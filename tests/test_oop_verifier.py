@@ -175,3 +175,63 @@ def test_requests_queue_until_worker_attaches(bus):
     bus.run_network()
     for f in futures:
         assert f.result(timeout=1) is None
+
+
+def test_fleet_status_and_worker_gauges(bus):
+    """Hello carries device shard + capacity; the node exposes them via
+    fleet_status() (the /readyz payload) and per-worker Fleet.* gauges on
+    the metrics registry (the /metrics payload)."""
+    node = bus.create_node("node")
+    svc = OutOfProcessTransactionVerifierService(node, expected_workers=2)
+    w1 = VerifierWorker(bus.create_node("w1"), "node",
+                        device_shard=(0, 1), capacity=2)
+    bus.run_network()
+
+    status = svc.fleet_status()
+    assert status["expected"] == 2
+    assert status["attached"] == 1
+    assert status["degraded"] is True          # 1 of 2 → degraded
+    assert status["workers"]["w1"]["device_shard"] == [0, 1]
+    assert status["workers"]["w1"]["capacity"] == 2
+
+    snap = svc.metrics.snapshot()
+    assert snap["Fleet.WorkersAttached"]["value"] == 1
+    assert snap["Fleet.WorkerCapacity.w1"]["value"] == 2
+    assert snap["Fleet.WorkerQueueDepth.w1"]["value"] == 0
+
+    w2 = VerifierWorker(bus.create_node("w2"), "node")
+    bus.run_network()
+    status = svc.fleet_status()
+    assert status["attached"] == 2 and status["degraded"] is False
+
+    w2.stop()   # graceful goodbye detaches; gauges read 0, not KeyError
+    bus.run_network()
+    snap = svc.metrics.snapshot()
+    assert svc.fleet_status()["degraded"] is True
+    assert snap["Fleet.WorkerCapacity.w2"]["value"] == 0
+    w1.stop()
+
+
+def test_load_aware_routing_prefers_idle_worker(bus):
+    """A worker reporting a deep backlog must stop receiving new deals
+    while an idle worker is in the slack band."""
+    from corda_tpu.verifier.out_of_process import WorkerLoadReport
+    node = bus.create_node("node")
+    svc = OutOfProcessTransactionVerifierService(node)
+    busy = VerifierWorker(bus.create_node("busy"), "node")
+    idle = VerifierWorker(bus.create_node("idle"), "node")
+    bus.run_network()
+
+    # hand-deliver the reports (deterministic: no worker threads involved)
+    svc.queue._on_load_report(WorkerLoadReport("busy", pending=64,
+                                               in_flight=12))
+    svc.queue._on_load_report(WorkerLoadReport("idle", pending=0,
+                                               in_flight=0))
+    futures = [svc.verify(make_ltx(i)) for i in range(8)]
+    bus.run_network()
+    for f in futures:
+        assert f.result(timeout=1) is None
+    assert idle.verified_count == 8
+    assert busy.verified_count == 0
+    busy.stop()
+    idle.stop()
