@@ -332,16 +332,81 @@ def service_metrics(k1_items, ed_items, r1_items) -> dict:
     }
 
 
+def _fleet_http_probe() -> dict:
+    """Smoke acceptance for the fleet observability plane, over REAL HTTP:
+    serve a live 2-worker fleet through NodeWebServer and check that
+    (a) /metrics carries at least one worker-labeled federated family,
+    (b) /traces returns a stitched trace holding node-side AND worker-side
+    spans for one request, and (c) /debug/requests has lifecycle timelines.
+    Returns {"http_federated_families": int, "http_stitched_traces": int,
+    "http_request_timelines": int}."""
+    import urllib.request
+    from corda_tpu.observability import Tracer, get_tracer, set_tracer
+    from corda_tpu.tools.webserver import NodeWebServer
+    from corda_tpu.verifier.fleet import InProcessFleet, make_sig_checks
+
+    class FleetOps:
+        """Minimal ops surface: just what the observability endpoints use."""
+        def __init__(self, fleet):
+            self._fleet = fleet
+
+        def metrics_snapshot(self):
+            return self._fleet.metrics.snapshot()
+
+        def fleet_status(self):
+            return self._fleet.service.fleet_status()
+
+        def request_timelines(self, limit=None):
+            return self._fleet.service.request_log.snapshot(limit=limit)
+
+    prev_tracer = get_tracer()
+    set_tracer(Tracer(capacity=4096))
+    fleet = InProcessFleet(2, use_device=False)
+    web = NodeWebServer(FleetOps(fleet)).start()
+    try:
+        checks = make_sig_checks(16)
+        for f in [fleet.verify_signatures(checks) for _ in range(8)]:
+            f.result(timeout=120)
+        time.sleep(0.05)   # let the pump deliver the next load reports
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{web.port}{path}", timeout=10) as r:
+                return r.read().decode()
+
+        metrics_text = fetch("/metrics")
+        federated = {line.split("{", 1)[0] for line in metrics_text.splitlines()
+                     if 'worker="' in line and not line.startswith("#")}
+        traces = json.loads(fetch("/traces")).get("traces", {})
+        stitched = 0
+        for spans in traces.values():
+            names = [s.get("name", "") for s in spans]
+            if ("verifier.oop_submit" in names
+                    and any(n.startswith("worker.") for n in names)):
+                stitched += 1
+        timelines = json.loads(fetch("/debug/requests"))["requests"]
+        return {"http_federated_families": len(federated),
+                "http_stitched_traces": stitched,
+                "http_request_timelines": len(timelines)}
+    finally:
+        web.stop()
+        fleet.close()
+        set_tracer(prev_tracer)
+
+
 def fleet_main() -> None:
     """--fleet: the multi-worker topology bench (corda_tpu.verifier.fleet).
     Smoke: 2 in-process host-route workers, no kernel compiles — a tier-1
-    wiring check that the router deals to BOTH workers and every future
-    resolves. Full: one device-pinned worker per local chip (the MULTICHIP
-    stage runs the same thing through __graft_entry__.dryrun_multichip)."""
+    wiring check that the router deals to BOTH workers, every future
+    resolves, and (via a real HTTP probe) the observability plane
+    federates worker metrics and stitches cross-process traces. Full: one
+    device-pinned worker per local chip (the MULTICHIP stage runs the same
+    thing through __graft_entry__.dryrun_multichip)."""
     from corda_tpu.verifier.fleet import fleet_bench
     if SMOKE:
         out = fleet_bench(2, groups=24, group_size=16, use_device=False)
         out["smoke"] = True
+        out.update(_fleet_http_probe())
     else:
         import jax
         devices = jax.devices()
@@ -357,6 +422,18 @@ def fleet_main() -> None:
     if idle:
         problems.append(f"workers {idle} processed nothing: the router "
                         f"never dealt to them")
+    if out["stitched_trace_depth"] < 2:
+        problems.append(f"stitched_trace_depth="
+                        f"{out['stitched_trace_depth']}: no trace crossed "
+                        f"the node/worker seam")
+    if SMOKE:
+        if out["http_federated_families"] < 1:
+            problems.append("no worker-labeled federated family on /metrics")
+        if out["http_stitched_traces"] < 1:
+            problems.append("no stitched cross-process trace on /traces")
+        if out["http_request_timelines"] < 1:
+            problems.append("no request lifecycle timelines on "
+                            "/debug/requests")
     print(json.dumps(out))
     if problems:
         for p in problems:

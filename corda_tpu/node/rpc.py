@@ -257,7 +257,13 @@ class CordaRPCOps:
                 degraded["fleet"] = {
                     "expected": fleet["expected"],
                     "attached": fleet["attached"],
-                    "workers": sorted(fleet["workers"])}
+                    "workers": sorted(fleet["workers"]),
+                    # workers whose last load report is older than 3× the
+                    # report interval: attached but possibly wedged
+                    "stale": sorted(fleet.get("stale", ())),
+                    "last_report_age_s": {
+                        w: info.get("last_report_age_s")
+                        for w, info in fleet["workers"].items()}}
         notary = getattr(self.hub, "notary_service", None)
         if notary is not None:
             raft = getattr(notary.uniqueness, "raft", None)
@@ -277,6 +283,20 @@ class CordaRPCOps:
         prep/device overlap."""
         from ..observability import get_profiler
         return get_profiler().snapshot()
+
+    def fleet_status(self) -> dict:
+        """Verifier-fleet picture for /api/fleet (and tools/fleetstat.py):
+        per-worker shard/capacity/queue-depth plus last-report freshness.
+        Empty dict when the node runs an in-process verifier."""
+        fleet_fn = getattr(self.hub.verifier_service, "fleet_status", None)
+        return fleet_fn() if fleet_fn is not None else {}
+
+    def request_timelines(self, limit: int | None = None) -> dict:
+        """Per-request lifecycle event timelines for /debug/requests
+        (submitted → routed → … → resolved), newest request first. Empty
+        when the verifier keeps no request log (in-process path)."""
+        log = getattr(self.hub.verifier_service, "request_log", None)
+        return log.snapshot(limit=limit) if log is not None else {}
 
     def vault_feed(self, state_type: type | None = None) -> DataFeed:
         def subscribe(cb):

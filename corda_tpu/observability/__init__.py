@@ -14,10 +14,15 @@ Three pieces (docs/OBSERVABILITY.md):
   device dispatch/wait wall time, batch occupancy, prep/device overlap;
   always-on, exported through /metrics and /debug/profile.
 - slog.py — structured JSON log lines correlated by trace_id.
+- federation.py — node-side accumulator for worker metric snapshots
+  (per-worker labeled families + Fleet.agg.* merges on /metrics).
+- lifecycle.py — bounded per-request event timelines (/debug/requests).
 
 The Histogram metric type itself lives in utils/metrics.py with the rest
 of the registry.
 """
+from .federation import FleetMetricsFederation
+from .lifecycle import RequestLog
 from .profiling import (KernelProfiler, OverlapTracker, get_profiler,
                         set_profiler)
 from .ring import SpanRing
@@ -25,11 +30,12 @@ from .slog import jlog
 from .stages import STAGE_METRICS, stage_percentiles
 from .tracing import (NOOP_SPAN, NOOP_TRACER, NoopTracer, Span, SpanContext,
                       Tracer, disable_tracing, enable_tracing, get_tracer,
-                      set_tracer)
+                      make_span_dict, set_tracer)
 
 __all__ = [
-    "KernelProfiler", "NOOP_SPAN", "NOOP_TRACER", "NoopTracer",
-    "OverlapTracker", "Span", "SpanContext", "SpanRing", "STAGE_METRICS",
-    "Tracer", "disable_tracing", "enable_tracing", "get_profiler",
-    "get_tracer", "jlog", "set_profiler", "set_tracer", "stage_percentiles",
+    "FleetMetricsFederation", "KernelProfiler", "NOOP_SPAN", "NOOP_TRACER",
+    "NoopTracer", "OverlapTracker", "RequestLog", "Span", "SpanContext",
+    "SpanRing", "STAGE_METRICS", "Tracer", "disable_tracing",
+    "enable_tracing", "get_profiler", "get_tracer", "jlog", "make_span_dict",
+    "set_profiler", "set_tracer", "stage_percentiles",
 ]

@@ -156,6 +156,9 @@ class NoopTracer:
     def record(self, name, parent=None, start_s=None, duration_s=0.0, **tags):
         return None
 
+    def ingest(self, span_dict) -> None:
+        return None
+
     def spans(self, trace_id=None, limit=None):
         return []
 
@@ -201,6 +204,24 @@ class Tracer:
             "duration_s": duration_s, "tags": dict(tags)})
         return SpanContext(trace_id, span_id)
 
+    def ingest(self, span_dict) -> None:
+        """Record a FINISHED span produced in another process (a verifier
+        worker's dict-built span, shipped back piggybacked on a reply or a
+        load report). The dict is normalized defensively — a malformed or
+        truncated span from an old worker is dropped, never raises."""
+        if not isinstance(span_dict, dict):
+            return
+        d = dict(span_dict)
+        if not d.get("trace_id") or not d.get("span_id"):
+            return
+        d.setdefault("name", "?")
+        d.setdefault("parent_id", None)
+        d.setdefault("start_s", 0.0)
+        d.setdefault("duration_s", 0.0)
+        if not isinstance(d.get("tags"), dict):
+            d["tags"] = {}
+        self.ring.record(d)
+
     def spans(self, trace_id=None, limit=None) -> list[dict]:
         return self.ring.snapshot(trace_id=trace_id, limit=limit)
 
@@ -209,6 +230,23 @@ class Tracer:
 
     def traces(self, limit_spans=None) -> dict:
         return self.ring.traces(limit_spans=limit_spans)
+
+
+def make_span_dict(name: str, parent, start_s: float, duration_s: float,
+                   **tags) -> dict:
+    """Build a finished span AS A DICT, bypassing the process tracer — the
+    worker half of cross-process stitching. A worker process (whose own
+    tracer is usually the no-op default) still produces real spans for any
+    request that arrived carrying a trace context; they ship back over the
+    wire and the node's tracer ``ingest``s them into its ring. ``parent``
+    is the wire ``(trace_id, span_id)`` tuple from the request."""
+    trace_id, parent_id = _parent_ids(parent)
+    if trace_id is None:
+        trace_id = _new_id()
+    return {"name": name, "trace_id": trace_id, "span_id": _new_id(),
+            "parent_id": parent_id, "start_s": start_s,
+            "duration_s": duration_s,
+            "tags": {k: v for k, v in tags.items() if v is not None}}
 
 
 # ---------------------------------------------------------------------------

@@ -196,9 +196,15 @@ MULTICHIP_GUARDED: dict = {
 }
 
 #: Fields every fleet artifact must carry (the --smoke --fleet schema gate).
+#: worker_busy_skew_pct / steals_total / stitched_trace_depth are the fleet
+#: observability plane's self-report: skew is the busy-time imbalance the
+#: stealer should be flattening, steals_total counts its interventions, and
+#: stitched_trace_depth proves cross-process trace stitching actually saw
+#: node- and worker-side spans joined under one trace id.
 MULTICHIP_REQUIRED: tuple = (
     "fleet_verifies_per_sec", "scaling_efficiency_pct", "n_workers",
     "n_devices", "fleet_steals", "per_worker_sigs",
+    "worker_busy_skew_pct", "steals_total", "stitched_trace_depth",
 )
 
 

@@ -1,0 +1,117 @@
+"""fleetstat — a `top`-style live view of a node's verifier fleet.
+
+Polls the node webserver's JSON surfaces (/api/fleet + /api/metrics) and
+renders one worker per row: attach state, report freshness, queue depth,
+capacity, and the federated per-worker throughput families. Pure-stdlib
+(urllib + ANSI clear), so it runs anywhere the node does::
+
+    python -m corda_tpu.tools.fleetstat http://127.0.0.1:8080
+    python -m corda_tpu.tools.fleetstat http://127.0.0.1:8080 --once
+
+``render()`` is a pure function of the two fetched payloads — the unit
+tests drive it with canned dicts, no HTTP involved.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+#: Federated per-worker families worth a column, in display order.
+#: SigBatcher.Checked counts every resolved signature (host or device
+#: route); DeviceChecked/DeviceBatches isolate the device path.
+_RATE_FAMILIES = (
+    ("SigBatcher.Checked", "checked"),
+    ("SigBatcher.DeviceChecked", "dev_checked"),
+    ("SigBatcher.DeviceBatches", "batches"),
+    ("Breaker.Trips", "trips"),
+)
+
+
+def fetch(base_url: str, path: str, timeout: float = 5.0):
+    with urllib.request.urlopen(base_url.rstrip("/") + path,
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _worker_counts(metrics: dict, worker: str) -> dict:
+    """Pull the federated count fields for one worker out of a node
+    /api/metrics payload (keys look like ``Family{worker="w0"}``)."""
+    out = {}
+    suffix = f'{{worker="{worker}"}}'
+    for family, label in _RATE_FAMILIES:
+        fields = metrics.get(family + suffix)
+        if isinstance(fields, dict):
+            c = fields.get("count", fields.get("value"))
+            if isinstance(c, (int, float)) and not isinstance(c, bool):
+                out[label] = int(c)
+    return out
+
+
+def render(fleet: dict, metrics: dict) -> str:
+    """One screenful: fleet header + a row per worker. Pure function of
+    the two JSON payloads."""
+    workers = fleet.get("workers") or {}
+    stale = set(fleet.get("stale") or ())
+    lines = [
+        "verifier fleet: "
+        f"{fleet.get('attached', 0)}/{fleet.get('expected') or '?'} attached"
+        + ("  DEGRADED" if fleet.get("degraded") else "")
+        + (f"  stale={sorted(stale)}" if stale else ""),
+        f"{'WORKER':<14}{'STATE':<10}{'AGE(s)':>8}{'DEPTH':>7}{'CAP':>5}"
+        f"{'CHECKED':>10}{'DEV_CHK':>10}{'BATCHES':>9}{'TRIPS':>7}",
+    ]
+    for name in sorted(workers):
+        w = workers[name]
+        age = w.get("last_report_age_s")
+        counts = _worker_counts(metrics, name)
+        lines.append(
+            f"{name:<14}"
+            f"{'stale' if (name in stale or w.get('stale')) else 'ok':<10}"
+            f"{age if age is not None else '-':>8}"
+            f"{w.get('queue_depth', 0):>7}"
+            f"{w.get('capacity', 1):>5}"
+            f"{counts.get('checked', 0):>10}"
+            f"{counts.get('dev_checked', 0):>10}"
+            f"{counts.get('batches', 0):>9}"
+            f"{counts.get('trips', 0):>7}")
+    if not workers:
+        lines.append("(no workers attached)")
+    agg = metrics.get("Fleet.agg.SigBatcher.Checked") or \
+        metrics.get("Fleet.agg.SigBatcher.DeviceChecked")
+    if isinstance(agg, dict):
+        lines.append(f"fleet aggregate checked: {agg.get('count', 0)}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fleetstat", description="top-like verifier fleet monitor")
+    ap.add_argument("url", help="node webserver base URL "
+                    "(e.g. http://127.0.0.1:8080)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (no screen clearing)")
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            fleet = fetch(args.url, "/api/fleet")
+            metrics = fetch(args.url, "/api/metrics")
+        except Exception as e:
+            print(f"fleetstat: cannot reach {args.url}: {e}",
+                  file=sys.stderr)
+            return 1
+        screen = render(fleet, metrics)
+        if args.once:
+            print(screen)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + screen + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

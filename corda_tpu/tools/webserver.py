@@ -15,6 +15,8 @@ app APIs and static content. Endpoints:
     GET  /healthz               liveness (200 when the server answers)
     GET  /readyz                readiness checks (200 ready / 503 not)
     GET  /debug/profile         kernel flight-recorder snapshot
+    GET  /debug/requests        per-request lifecycle timelines (fleet)
+    GET  /api/fleet             fleet membership + per-worker load
     GET  /traces                span ring (tracing enabled: spans by trace)
     POST /api/flows/<FlowName>  body: JSON list of args -> run id / result
     GET  /web/<app>/<path>      static app content (staticServeDirs role)
@@ -60,6 +62,24 @@ def _family(lines: list, name: str, mtype: str, help_text: str,
         lines.append(line)
 
 
+def _entry_identity(name: str, fields) -> tuple[str, list]:
+    """Snapshot entry → (family name, label pairs). Federated entries
+    (observability/federation.py) carry ``family``/``labels`` metadata so
+    N workers' copies of one family share a base name and differ only in
+    their ``worker="..."`` label; plain entries are their own family with
+    no labels."""
+    labels: list = []
+    family = name
+    if isinstance(fields, dict):
+        fam = fields.get("family")
+        if isinstance(fam, str) and fam:
+            family = fam
+        lab = fields.get("labels")
+        if isinstance(lab, dict):
+            labels = sorted((str(k), str(v)) for k, v in lab.items())
+    return family, labels
+
+
 def prometheus_text(snapshot: dict) -> str:
     """Metric snapshot → Prometheus text exposition.
 
@@ -71,62 +91,87 @@ def prometheus_text(snapshot: dict) -> str:
     resolvable against /traces) plus ``_sum``/``_count`` and quantile
     gauges. Label values are escaped; names sanitized + corda_tpu_ prefix.
     Entries without a ``type`` fall back to one untyped sample per numeric
-    field (older snapshots, ad-hoc dicts)."""
+    field (older snapshots, ad-hoc dicts).
+
+    Entries carrying ``family``/``labels`` metadata (worker-federated
+    families) are GROUPED: one HELP/TYPE header per derived family, then
+    one labeled sample per instance — N workers' ``SigBatcher.Flushes``
+    become one ``corda_tpu_sigbatcher_flushes_count`` family with
+    ``worker="w0"`` / ``worker="w1"`` samples, never duplicate headers."""
+    groups: dict[str, dict] = {}
+    for name, fields in snapshot.items():
+        family, labels = _entry_identity(name, fields)
+        base = "corda_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", family).lower()
+        g = groups.setdefault(base, {"family": family, "instances": []})
+        g["instances"].append((labels, fields))
+
     lines: list = []
-    for name, fields in sorted(snapshot.items()):
-        base = "corda_tpu_" + re.sub(r"[^a-zA-Z0-9_]", "_", name).lower()
-        mtype = fields.get("type") if isinstance(fields, dict) else None
+    for base in sorted(groups):
+        name = groups[base]["family"]
+        instances = sorted(groups[base]["instances"], key=lambda i: i[0])
+        mtype = next((f.get("type") for _l, f in instances
+                      if isinstance(f, dict) and f.get("type")), None)
+        typed = [(labels or None, f) for labels, f in instances
+                 if isinstance(f, dict) and f.get("type") == mtype]
+
+        def samples(field, suffix=""):
+            return [(suffix, labels, f[field], None) for labels, f in typed]
+
         if mtype == "meter":
             _family(lines, f"{base}_count", "counter",
-                    f"Total events of {name}",
-                    [("", None, fields["count"], None)])
+                    f"Total events of {name}", samples("count"))
             _family(lines, f"{base}_mean_rate", "gauge",
                     f"Mean event rate of {name} (1/s)",
-                    [("", None, fields["mean_rate"], None)])
+                    samples("mean_rate"))
         elif mtype == "timer":
             _family(lines, f"{base}_count", "counter",
-                    f"Total timed operations of {name}",
-                    [("", None, fields["count"], None)])
+                    f"Total timed operations of {name}", samples("count"))
             _family(lines, f"{base}_mean_s", "gauge",
-                    f"Mean duration of {name} (s)",
-                    [("", None, fields["mean_s"], None)])
+                    f"Mean duration of {name} (s)", samples("mean_s"))
             _family(lines, f"{base}_max_s", "gauge",
-                    f"Max duration of {name} (s)",
-                    [("", None, fields["max_s"], None)])
+                    f"Max duration of {name} (s)", samples("max_s"))
         elif mtype == "counter":
             _family(lines, f"{base}_value", "gauge",
-                    f"Current value of {name}",
-                    [("", None, fields["value"], None)])
+                    f"Current value of {name}", samples("value"))
         elif mtype == "gauge":
             _family(lines, f"{base}_value", "gauge",
-                    f"Current level of {name}",
-                    [("", None, fields["value"], None)])
+                    f"Current level of {name}", samples("value"))
             _family(lines, f"{base}_max", "gauge",
-                    f"High-water mark of {name}",
-                    [("", None, fields["max"], None)])
+                    f"High-water mark of {name}", samples("max"))
         elif mtype == "gauge_fn":
-            v = fields.get("value")
-            if isinstance(v, (int, float)) and not isinstance(v, bool):
+            gauge_samples = [
+                ("", labels, f.get("value"), None) for labels, f in typed
+                if isinstance(f.get("value"), (int, float))
+                and not isinstance(f.get("value"), bool)]
+            if gauge_samples:
                 _family(lines, f"{base}_value", "gauge",
-                        f"Current value of {name}",
-                        [("", None, v, None)])
+                        f"Current value of {name}", gauge_samples)
         elif mtype == "histogram":
-            exemplars = fields.get("exemplars", {})
-            samples = [("_bucket", [("le", le)], cum, exemplars.get(le))
-                       for le, cum in fields.get("buckets", [])]
-            samples.append(("_sum", None, fields["sum"], None))
-            samples.append(("_count", None, fields["count"], None))
+            hist_samples: list = []
+            for labels, f in typed:
+                exemplars = f.get("exemplars") or {}
+                for le, cum in f.get("buckets", []):
+                    hist_samples.append(
+                        ("_bucket", (labels or []) + [("le", le)], cum,
+                         exemplars.get(le)))
+                hist_samples.append(("_sum", labels, f["sum"], None))
+                hist_samples.append(("_count", labels, f["count"], None))
             _family(lines, base, "histogram",
-                    f"Distribution of {name}", samples)
+                    f"Distribution of {name}", hist_samples)
             for q in ("max", "mean", "p50", "p90", "p99"):
                 _family(lines, f"{base}_{q}", "gauge",
-                        f"{q} of {name}", [("", None, fields[q], None)])
+                        f"{q} of {name}", samples(q))
         else:
             # legacy/ad-hoc entry: one untyped sample per numeric field
-            for k, v in (fields.items() if isinstance(fields, dict) else ()):
-                if isinstance(v, bool) or not isinstance(v, (int, float)):
+            for labels, fields in instances:
+                if not isinstance(fields, dict):
                     continue
-                lines.append(f"{base}_{k} {v}")
+                label_s = "" if not labels else "{" + ",".join(
+                    f'{k}="{_escape_label(v)}"' for k, v in labels) + "}"
+                for k, v in fields.items():
+                    if isinstance(v, bool) or not isinstance(v, (int, float)):
+                        continue
+                    lines.append(f"{base}_{k}{label_s} {v}")
     return "\n".join(lines) + "\n"
 
 
@@ -234,6 +279,16 @@ class NodeWebServer:
                     except Exception as e:
                         self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
+                if (self.path == "/debug/requests"
+                        or self.path.startswith("/debug/requests?")):
+                    try:
+                        self._reply(200, server.handle_debug_requests(
+                            self.path))
+                    except ValueError as e:
+                        self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                    except Exception as e:
+                        self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
                 if self.path == "/metrics":   # Prometheus scrape endpoint
                     try:
                         self._reply_raw(
@@ -300,6 +355,9 @@ class NodeWebServer:
             return self.ops.registered_flows()
         if path == "/api/metrics":
             return self.ops.metrics_snapshot()
+        if path == "/api/fleet":
+            fleet_fn = getattr(self.ops, "fleet_status", None)
+            return fleet_fn() if fleet_fn is not None else {}
         raise RouteNotFound(path)
 
     def handle_readyz(self) -> dict:
@@ -320,6 +378,20 @@ class NodeWebServer:
             return profile_fn()
         from ..observability import get_profiler
         return get_profiler().snapshot()
+
+    def handle_debug_requests(self, path: str) -> dict:
+        """GET /debug/requests — the newest per-request lifecycle
+        timelines (observability/lifecycle.py RequestLog) from the ops
+        object, empty for an ops surface without one. ``limit`` caps the
+        number of requests returned."""
+        from urllib.parse import parse_qs, urlsplit
+        q = parse_qs(urlsplit(path).query)
+        limit_raw = q.get("limit", [None])[0]
+        limit = int(limit_raw) if limit_raw is not None else None
+        timelines_fn = getattr(self.ops, "request_timelines", None)
+        if timelines_fn is None:
+            return {"requests": {}}
+        return {"requests": timelines_fn(limit)}
 
     def handle_traces(self, path: str) -> tuple[str, bytes]:
         """GET /traces — spans from the live tracer's ring buffer.

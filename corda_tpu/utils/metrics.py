@@ -210,6 +210,7 @@ class MetricRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: dict[str, object] = {}
+        self._collectors: list = []
 
     def _get(self, name: str, cls):
         with self._lock:
@@ -251,6 +252,15 @@ class MetricRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def add_collector(self, fn) -> None:
+        """Register a zero-arg callable returning ``{name: fields}`` whose
+        entries ride every ``snapshot()`` — the seam federated (per-worker
+        labeled) families use to appear on /metrics without being local
+        metric objects. Locally-registered metrics win on name collision;
+        a raising collector is skipped, never kills the snapshot."""
+        with self._lock:
+            self._collectors.append(fn)
+
     def snapshot(self) -> dict:
         """Registry → {name: fields} with a ``type`` discriminator per
         metric, so exporters (prometheus_text) can render each family
@@ -258,6 +268,14 @@ class MetricRegistry:
         out = {}
         with self._lock:
             items = list(self._metrics.items())
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                extra = fn()
+            except Exception:
+                continue
+            if isinstance(extra, dict):
+                out.update(extra)
         for name, m in items:
             if isinstance(m, Meter):
                 out[name] = {"type": "meter", "count": m.count,

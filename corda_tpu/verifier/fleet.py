@@ -28,6 +28,7 @@ from ..core.crypto import generate_keypair
 from ..core.crypto.schemes import EDDSA_ED25519_SHA512
 from ..core.crypto.signatures import Crypto
 from ..network.inmemory import InMemoryMessagingNetwork
+from ..observability import Tracer, get_tracer, set_tracer
 from ..utils.metrics import MetricRegistry
 from .batcher import SignatureBatcher
 from .out_of_process import (OutOfProcessTransactionVerifierService,
@@ -73,7 +74,8 @@ class InProcessFleet:
         self.bus = InMemoryMessagingNetwork()
         self.service = OutOfProcessTransactionVerifierService(
             self.bus.create_node("node"), metrics=self.metrics,
-            expected_workers=n_workers)
+            expected_workers=n_workers,
+            load_report_interval_s=report_every_s)
         batcher_kwargs: dict = {"use_device": use_device,
                                 "max_latency_s": max_latency_s}
         if host_crossover is not None:
@@ -139,6 +141,32 @@ class InProcessFleet:
         self.service.shutdown()
 
 
+def stitched_trace_depth(spans) -> int:
+    """Deepest parent chain among traces that contain BOTH a node-side
+    ``verifier.oop_submit`` span and at least one ``worker.*`` span — i.e.
+    traces that actually crossed the process seam. 0 means no stitched
+    trace existed (the cross-process plane was dark)."""
+    by_trace: dict[str, list[dict]] = {}
+    for s in spans:
+        if isinstance(s, dict) and s.get("trace_id"):
+            by_trace.setdefault(s["trace_id"], []).append(s)
+    best = 0
+    for group in by_trace.values():
+        names = [s.get("name") or "" for s in group]
+        if ("verifier.oop_submit" not in names
+                or not any(n.startswith("worker.") for n in names)):
+            continue
+        by_id = {s["span_id"]: s for s in group if s.get("span_id")}
+        for s in group:
+            depth, cur, hops = 1, s, 0
+            while cur.get("parent_id") in by_id and hops < len(by_id):
+                cur = by_id[cur["parent_id"]]
+                depth += 1
+                hops += 1
+            best = max(best, depth)
+    return best
+
+
 def fleet_bench(n_workers: int, groups: int = 64, group_size: int = 16,
                 use_device: bool = False, devices=None,
                 host_crossover: int | None = None,
@@ -146,7 +174,15 @@ def fleet_bench(n_workers: int, groups: int = 64, group_size: int = 16,
                 unique: int = 16, timeout_s: float = 600.0) -> dict:
     """Run ``groups`` signature groups of ``group_size`` ed25519 checks
     through an N-worker fleet and measure aggregate throughput + busy-time
-    scaling efficiency. Returns the MULTICHIP artifact fields."""
+    scaling efficiency. Returns the MULTICHIP artifact fields.
+
+    Runs under a PRIVATE recording tracer (restored on exit) so the
+    artifact can report ``stitched_trace_depth`` — proof the cross-process
+    observability plane stitched node- and worker-side spans — without
+    clobbering any tracer the host process installed."""
+    prev_tracer = get_tracer()
+    tracer = Tracer(capacity=16384)
+    set_tracer(tracer)
     fleet = InProcessFleet(
         n_workers, use_device=use_device, devices=devices,
         host_crossover=host_crossover,
@@ -165,15 +201,22 @@ def fleet_bench(n_workers: int, groups: int = 64, group_size: int = 16,
                 for w in fleet.workers]
         efficiency = (100.0 * (sum(busy) / len(busy)) / makespan
                       if makespan > 0 else 0.0)
+        skew = (100.0 * (max(busy) - min(busy)) / makespan
+                if makespan > 0 else 0.0)
         per_worker = {w.network_service.my_address: w.processed_sig_count
                       for w in fleet.workers}
+        steals = fleet.steal_count()
         return {
             "fleet_verifies_per_sec": round(total / makespan, 1),
             "scaling_efficiency_pct": round(min(100.0, efficiency), 1),
+            "worker_busy_skew_pct": round(max(0.0, min(100.0, skew)), 1),
             "n_workers": n_workers,
             "n_devices": len(devices) if devices is not None else 0,
-            "fleet_steals": fleet.steal_count(),
+            "fleet_steals": steals,
             "fleet_stolen": fleet.stolen_count(),
+            "steals_total": steals,
+            "stitched_trace_depth": stitched_trace_depth(
+                tracer.ring.snapshot()),
             "groups": groups,
             "group_size": group_size,
             "wall_s": round(makespan, 4),
@@ -181,3 +224,4 @@ def fleet_bench(n_workers: int, groups: int = 64, group_size: int = 16,
         }
     finally:
         fleet.close()
+        set_tracer(prev_tracer)

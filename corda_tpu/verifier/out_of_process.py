@@ -26,17 +26,20 @@ in-memory bus in tests, the TCP plane in production.
 from __future__ import annotations
 
 import itertools
+import json
 import logging
 import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 from typing import Any
 
 from ..core.serialization import deserialize, register_type, serialize
 from ..network.messaging import (TOPIC_VERIFIER_REQUESTS,
                                  TOPIC_VERIFIER_RESPONSES, TopicSession)
+from ..observability import (FleetMetricsFederation, RequestLog, get_tracer,
+                             make_span_dict)
 from ..utils import retry
 from ..utils.faults import DROP, fault_point
 from ..utils.metrics import MetricRegistry
@@ -61,12 +64,23 @@ class VerificationRequest:
     transaction: Any          # LedgerTransaction
     response_address: str
     signatures: tuple = ()    # ((PublicKey, sig_bytes, content_bytes), ...)
+    #: Serialized SpanContext ``(trace_id, span_id)`` of the node-side
+    #: verifier.oop_submit span — the worker parents its child spans here.
+    #: Trailing default keeps old-worker decode working (cross-process
+    #: trace stitching; empty when node tracing is off).
+    trace: tuple = ()
 
 
 @dataclass(frozen=True)
 class VerificationResponse:
     verification_id: int
     error_message: str | None
+    #: Finished worker-side span dicts (backlog wait, device dispatch,
+    #: host verify) piggybacked on the reply — the node ``ingest``s them
+    #: into its span ring to stitch the end-to-end trace. JSON-encoded
+    #: (``_pack_obs``): span timings are floats, which the codec forbids
+    #: in typed consensus data; the diagnostic payload rides as a string.
+    spans: str = ""
 
 
 @dataclass(frozen=True)
@@ -102,6 +116,14 @@ class WorkerLoadReport:
     in_flight: int
     queue_depths: tuple = ()    # ((scheme, depth), ...)
     capacity: int = 1
+    #: Finished spans with no reply to ride (worker.stolen parked-time
+    #: spans) — drained from the worker's span outbox onto the next
+    #: report. JSON-encoded list (``_pack_obs``).
+    spans: str = ""
+    #: The worker's metric registry snapshot, JSON-encoded
+    #: ``{family: fields}`` — the node federates these into worker-labeled
+    #: /metrics families (observability/federation.py).
+    metrics: str = ""
 
 
 @dataclass(frozen=True)
@@ -112,6 +134,9 @@ class StealRequest:
 
     thief_address: str
     max_items: int
+    #: SpanContext of the node's verifier.steal_request span — stolen-work
+    #: spans tag it so a steal decision cross-links to the requests it moved.
+    trace: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -126,6 +151,34 @@ class WorkReturned:
 for _cls in (VerificationRequest, VerificationResponse, WorkerHello,
              WorkerGoodbye, WorkerLoadReport, StealRequest, WorkReturned):
     register_type(f"verifier.{_cls.__name__}", _cls)
+
+
+def _pack_obs(obj) -> str:
+    """Observability piggyback (span lists / metric snapshots) → JSON
+    string. The codec deliberately rejects floats in typed wire data
+    (non-deterministic in consensus), but span durations and metric rates
+    ARE floats — so the diagnostic payload travels as one opaque string
+    and never constrains (or is constrained by) consensus typing. Returns
+    "" for empty/unserializable input: observability must never fail a
+    verification message."""
+    if not obj:
+        return ""
+    try:
+        return json.dumps(obj, default=str)
+    except (TypeError, ValueError):
+        return ""
+
+
+def _unpack_obs(blob, default):
+    """Inverse of _pack_obs — tolerant: anything malformed (an old worker,
+    a truncated report) yields ``default`` rather than raising."""
+    if not blob or not isinstance(blob, str):
+        return default
+    try:
+        out = json.loads(blob)
+    except ValueError:
+        return default
+    return out if isinstance(out, type(default)) else default
 
 
 def _weight(req: VerificationRequest) -> int:
@@ -196,6 +249,12 @@ class VerifierRequestQueue:
         self._affinity: dict[str, str] = {}
         self._steal_inflight: dict[str, float] = {}
         self._gauged: set[str] = set()
+        # fleet observability plane: per-request lifecycle timelines
+        # (/debug/requests + request.* jlog events) and the worker-metrics
+        # federation whose families ride every metrics snapshot
+        self.request_log = RequestLog()
+        self.federation = FleetMetricsFederation()
+        self.metrics.add_collector(self.federation.snapshot)
         self.metrics.gauge("Fleet.WorkersAttached",
                            lambda: len(self._workers))
         network_service.add_message_handler(
@@ -262,6 +321,11 @@ class VerifierRequestQueue:
             self._shards.pop(worker, None)
             self._affinity.pop(worker, None)
             self._steal_inflight.pop(worker, None)
+        self.federation.detach(worker)
+        for req in held:
+            self.request_log.append(req.verification_id, "requeued",
+                                    trace=req.trace or None,
+                                    reason="worker-detached", worker=worker)
         self._drain()
 
     # -- load reports + work stealing ----------------------------------------
@@ -275,6 +339,16 @@ class VerifierRequestQueue:
             self._last_activity[worker] = now
             if report.capacity:
                 self._capacity[worker] = max(1, int(report.capacity))
+        # piggybacked observability: orphan spans (stolen parked-time) into
+        # the span ring, the metric snapshot into the federation
+        spans = _unpack_obs(report.spans, [])
+        if spans:
+            tracer = get_tracer()
+            for s in spans:
+                tracer.ingest(s)
+        metrics = _unpack_obs(report.metrics, {})
+        if metrics:
+            self.federation.ingest(worker, metrics)
         # a newly idle worker can take pending work right away — and may
         # justify stealing from a straggler's backlog
         self._drain()
@@ -305,6 +379,16 @@ class VerifierRequestQueue:
             self._pending = requeued + self._pending
         if requeued:
             self.metrics.meter("Fleet.Stolen").mark(len(requeued))
+            tracer = get_tracer()
+            for req in requeued:
+                self.request_log.append(req.verification_id, "stolen",
+                                        trace=req.trace or None,
+                                        victim=victim)
+                if req.trace:
+                    # node-side steal-hop marker inside the request's own
+                    # trace: the stitched tree shows the re-deal boundary
+                    tracer.record("verifier.steal_return",
+                                  parent=tuple(req.trace), victim=victim)
         self._drain()
 
     def _maybe_steal(self) -> None:
@@ -336,12 +420,21 @@ class VerifierRequestQueue:
             self._steal_inflight[victim] = now
             thief = idle[0]
         self.metrics.meter("Fleet.Steals").mark()
+        steal_trace: tuple = ()
+        tracer = get_tracer()
+        if tracer.enabled:
+            ctx = tracer.record("verifier.steal_request", thief=thief,
+                                victim=victim,
+                                max_items=self.STEAL_MAX_ITEMS)
+            if ctx is not None:
+                steal_trace = ctx.as_tuple()
         try:
             if fault_point("oop.deliver", detail=f"->{victim}") == DROP:
                 return   # lost steal: the timeout forgets it
             self.network_service.send(
                 TopicSession(TOPIC_VERIFIER_REQUESTS),
-                serialize(StealRequest(thief, self.STEAL_MAX_ITEMS)), victim)
+                serialize(StealRequest(thief, self.STEAL_MAX_ITEMS,
+                                       steal_trace)), victim)
         except Exception:
             log.warning("steal request to verifier %s failed; detaching",
                         victim, exc_info=True)
@@ -366,30 +459,36 @@ class VerifierRequestQueue:
         return (base + dealt) / max(1, self._capacity.get(worker, 1))
 
     def _pick_worker_locked(self, req: VerificationRequest,
-                            now: float) -> str:
+                            now: float) -> tuple[str, str, dict]:
         """The router: workers within ROUTE_SLACK of the least estimated
         load are candidates; among candidates, prefer the ones whose last
         dealt bucket matches this request's dominant scheme (a warm batcher
         queue coalesces same-scheme groups into fuller device batches);
         round-robin breaks the remaining tie so light load keeps the old
-        fair dealing."""
+        fair dealing. Returns ``(pick, reason, est-load vector)`` — the
+        decision record the request's lifecycle timeline keeps, so a
+        misrouted request is debuggable from the loads the router SAW."""
         if len(self._workers) == 1:
-            return self._workers[0]
+            only = self._workers[0]
+            return only, "single-worker", {
+                only: round(self._est_load_locked(only, now), 2)}
         loads = {w: self._est_load_locked(w, now) for w in self._workers}
         best = min(loads.values())
         slack = max(self.ROUTE_SLACK, best * 0.25)
         candidates = [w for w in self._workers if loads[w] <= best + slack]
+        reason = "least-loaded-rr"
         bucket = _dominant_bucket(req.signatures)
         if bucket is not None:
             affine = [w for w in candidates
                       if self._affinity.get(w) == bucket]
             if affine:
                 candidates = affine
+                reason = f"affinity:{bucket}"
         pick = candidates[self._rr % len(candidates)]
         self._rr += 1
         if bucket is not None:
             self._affinity[pick] = bucket
-        return pick
+        return pick, reason, {w: round(v, 2) for w, v in loads.items()}
 
     def requeue_overdue(self) -> None:
         """Declare dead any worker that is BOTH holding a request past the
@@ -419,21 +518,30 @@ class VerifierRequestQueue:
         with self._lock:
             self._pending.append(request)
             no_worker = not self._workers
+        self.request_log.append(request.verification_id, "submitted",
+                                trace=request.trace or None,
+                                n_sigs=len(request.signatures))
         if no_worker:
+            self.request_log.append(request.verification_id, "parked",
+                                    trace=request.trace or None,
+                                    reason="no-worker-attached")
             log.warning("verification request queued but no verifier is "
                         "attached (reference warns every 10s here)")
         self._drain()
 
-    def acknowledge(self, verification_id: int) -> None:
-        """Retire a completed request from its worker's outstanding list."""
+    def acknowledge(self, verification_id: int) -> str | None:
+        """Retire a completed request from its worker's outstanding list;
+        returns the worker it was charged to (None for an unknown or
+        already-acknowledged id)."""
         with self._lock:
             worker, _ = self._dealt_at.pop(verification_id, (None, 0.0))
             if worker is None:
-                return
+                return None
             self._last_activity[worker] = time.monotonic()
             held = self._outstanding.get(worker, [])
             self._outstanding[worker] = [
                 r for r in held if r.verification_id != verification_id]
+        return worker
 
     def _drain(self) -> None:
         while True:
@@ -441,10 +549,14 @@ class VerifierRequestQueue:
                 if not self._pending or not self._workers:
                     return
                 req = self._pending.pop(0)
-                worker = self._pick_worker_locked(req, time.monotonic())
+                worker, reason, loads = self._pick_worker_locked(
+                    req, time.monotonic())
                 self._outstanding[worker].append(req)
                 self._dealt_at[req.verification_id] = (worker,
                                                        time.monotonic())
+            self.request_log.append(req.verification_id, "routed",
+                                    trace=req.trace or None, worker=worker,
+                                    reason=reason, est_load=loads)
             try:
                 # a "drop" rule here models a lost delivery (the worker
                 # never sees the request): the redelivery-timeout scan is
@@ -471,18 +583,27 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
 
     def __init__(self, network_service, metrics: MetricRegistry | None = None,
                  redelivery_timeout_s: float | None = None,
-                 expected_workers: int | None = None):
+                 expected_workers: int | None = None,
+                 load_report_interval_s: float | None = None):
         self.metrics = metrics if metrics is not None else MetricRegistry()
         self.network_service = network_service
         # expected fleet size (config): /readyz compares attached against it
         # and reports a partial fleet as degraded (fleet_status)
         self.expected_workers = expected_workers
+        # the interval workers were configured to report at: fleet_status
+        # flags a worker silent past 3× it as stale/degraded (None = the
+        # deployment has no report loop, staleness is not judged)
+        self.load_report_interval_s = load_report_interval_s
         self.queue = VerifierRequestQueue(
             network_service, redelivery_timeout_s=redelivery_timeout_s,
             metrics=self.metrics)
         self._ids = itertools.count(1)
         self._handles: dict[int, Future] = {}
         self._timers: dict[int, object] = {}
+        # vid -> live verifier.oop_submit span: opened at submit, finished
+        # EXACTLY ONCE when the final response lands — a request that gets
+        # stolen or crash-requeued keeps its span open across the re-deal
+        self._spans: dict[int, object] = {}
         self._scanner = None
         self._stopping = threading.Event()
         network_service.add_message_handler(
@@ -521,19 +642,46 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
 
     def fleet_status(self) -> dict:
         """Fleet membership + per-worker load for /readyz: attached vs
-        expected, and each worker's shard / capacity / estimated depth."""
+        expected, each worker's shard / capacity / estimated depth, and
+        report freshness — ``last_report_age_s`` per worker, with workers
+        silent past 3× the configured load-report interval flagged
+        ``stale`` (the whole fleet reads degraded while any worker is:
+        the router is flying blind on its load)."""
         q = self.queue
+        interval = self.load_report_interval_s
+        now = time.monotonic()
+        stale: list[str] = []
         with q._lock:
-            workers = {
-                w: {"device_shard": list(q._shards.get(w, ())),
+            workers = {}
+            for w in q._workers:
+                rep = q._reports.get(w)
+                age = (now - rep[1]) if rep is not None else None
+                # a just-attached worker has no report yet: judge it from
+                # its hello (last_activity), not as instantly stale
+                seen = rep[1] if rep is not None \
+                    else q._last_activity.get(w, now)
+                is_stale = (interval is not None
+                            and now - seen > 3.0 * interval)
+                if is_stale:
+                    stale.append(w)
+                workers[w] = {
+                    "device_shard": list(q._shards.get(w, ())),
                     "capacity": q._capacity.get(w, 1),
-                    "queue_depth": q._queue_depth_of(w)}
-                for w in q._workers}
+                    "queue_depth": q._queue_depth_of(w),
+                    "last_report_age_s": (round(age, 3)
+                                          if age is not None else None),
+                    "stale": is_stale}
         out = {"expected": self.expected_workers, "attached": len(workers),
-               "workers": workers}
-        out["degraded"] = (self.expected_workers is not None
-                           and len(workers) < self.expected_workers)
+               "workers": workers, "stale": stale}
+        out["degraded"] = bool(stale) or (
+            self.expected_workers is not None
+            and len(workers) < self.expected_workers)
         return out
+
+    @property
+    def request_log(self) -> RequestLog:
+        """Per-request lifecycle timelines (the /debug/requests payload)."""
+        return self.queue.request_log
 
     def verify_signatures(self, checks) -> Future:
         """Bulk signature-group verification through the fleet: one future
@@ -558,13 +706,9 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         SignedTransaction.kt:174-178, shipped over the VerifierApi seam).
         Coverage (missing-signer) checks are cheap and need the stx, so they
         run node-side before dispatch; resolution happens node-side because
-        it needs the ServiceHub. The worker hop is opaque to tracing — one
-        "verifier.oop_submit" span marks the dispatch in the caller's
-        trace."""
-        from ..observability import get_tracer
-        get_tracer().record("verifier.oop_submit", parent=trace_ctx,
-                            tx_id=stx.id.bytes.hex()[:16],
-                            n_sigs=len(stx.sigs))
+        it needs the ServiceHub. The worker hop is TRACED: the submit span's
+        context rides the request and the worker's child spans ship back on
+        the reply (cross-process stitching)."""
         if check_sufficient_signatures:
             missing = stx.get_missing_signatures()
             if missing:
@@ -576,10 +720,23 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
                 return fut
         ltx = stx.to_ledger_transaction(services)
         sigs = tuple((sig.by, sig.bytes, stx.id.bytes) for sig in stx.sigs)
-        return self._submit(VerificationRequest(
-            next(self._ids), ltx, self.network_service.my_address, sigs))
+        return self._submit(
+            VerificationRequest(next(self._ids), ltx,
+                                self.network_service.my_address, sigs),
+            trace_ctx=trace_ctx, tx_id=stx.id.bytes.hex()[:16])
 
-    def _submit(self, request: VerificationRequest) -> Future:
+    def _submit(self, request: VerificationRequest, trace_ctx=None,
+                **tags) -> Future:
+        # a LIVE span per request, finished exactly once in _on_response:
+        # its duration covers the whole fleet round-trip, including any
+        # steal hops and crash-requeues in between. With tracing off this
+        # is the shared no-op span and the request ships without a context.
+        span = get_tracer().span("verifier.oop_submit", parent=trace_ctx,
+                                 n_sigs=len(request.signatures), **tags)
+        ctx = span.context()
+        if ctx is not None:
+            request = dc_replace(request, trace=ctx.as_tuple())
+            self._spans[request.verification_id] = span
         fut: Future = Future()
         self._handles[request.verification_id] = fut
         timer = self.metrics.timer("Verification.Duration")
@@ -595,8 +752,34 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         if timer is not None:
             timer.__exit__(None, None, None)
         if fut is None:
-            return
-        self.queue.acknowledge(resp.verification_id)
+            return   # duplicate reply: the first copy finished the span too
+        worker = self.queue.acknowledge(resp.verification_id)
+        # stitch: worker-side spans from the reply into the node's ring
+        tracer = get_tracer()
+        dispatched = None
+        for s in _unpack_obs(resp.spans, []):
+            tracer.ingest(s)
+            if isinstance(s, dict) and s.get("name") == "worker.device_dispatch":
+                dispatched = s
+        span = self._spans.pop(resp.verification_id, None)
+        trace = None
+        if span is not None:
+            trace = span.context().as_tuple()
+            if worker is not None:
+                span.set_tag("worker", worker)
+            if resp.error_message is not None:
+                span.set_tag("error", resp.error_message)
+            span.finish()
+        rlog = self.queue.request_log
+        if dispatched is not None:
+            tags = dispatched.get("tags", {})
+            rlog.append(resp.verification_id, "dispatched", trace=trace,
+                        worker=tags.get("worker"),
+                        n_sigs=tags.get("n_sigs"),
+                        duration_s=round(dispatched.get("duration_s", 0.0),
+                                         6))
+        rlog.append(resp.verification_id, "resolved", trace=trace,
+                    ok=resp.error_message is None, worker=worker)
         if resp.error_message is None:
             self.metrics.meter("Verification.Success").mark()
             fut.set_result(None)
@@ -646,6 +829,13 @@ class VerifierWorker:
         self.max_inflight_groups = max_inflight_groups
         self._backlog: "deque[VerificationRequest]" = deque()
         self._backlog_lock = threading.Lock()
+        # trace stitching state (only populated for requests that ARRIVE
+        # carrying a trace context, i.e. node tracing is on): arrival wall
+        # time per vid feeds the backlog-wait span; the outbox holds
+        # finished spans with no reply to ride (worker.stolen), drained
+        # onto the next load report
+        self._arrival: dict[int, float] = {}
+        self._span_outbox: "deque[dict]" = deque(maxlen=512)
         self._inflight_groups = 0
         self._inflight_sigs = 0
         self._report_enabled = load_report_interval_s is not None
@@ -701,22 +891,43 @@ class VerifierWorker:
         """Ship the live load picture to the node's router: stealable
         backlog weight + batcher in-flight signatures + the per-scheme
         queue-depth gauges. Called on the report interval, on going idle,
-        and by hand from deterministic tests."""
+        and by hand from deterministic tests.
+
+        Federation piggyback: the worker's full metric snapshot rides each
+        report (the node re-exports it under a worker label), along with
+        any orphan spans waiting in the outbox."""
         with self._backlog_lock:
             pending = sum(_weight(r) for r in self._backlog)
             in_flight = self._inflight_sigs
         depths: tuple = ()
+        metrics: str = ""
         if self._batcher is not None:
             try:
                 depths = tuple(sorted(self._batcher.queue_depths().items()))
             except Exception:
                 depths = ()
-        self.network_service.send(
-            TopicSession(TOPIC_VERIFIER_REQUESTS),
-            serialize(WorkerLoadReport(
-                self.network_service.my_address, pending, in_flight,
-                depths, self.capacity)),
-            self.queue_address)
+            try:
+                metrics = _pack_obs(self._batcher.metrics.snapshot())
+            except Exception:
+                metrics = ""
+        spans: list = []
+        while len(spans) < 128:
+            try:
+                spans.append(self._span_outbox.popleft())
+            except IndexError:
+                break
+        try:
+            self.network_service.send(
+                TopicSession(TOPIC_VERIFIER_REQUESTS),
+                serialize(WorkerLoadReport(
+                    self.network_service.my_address, pending, in_flight,
+                    depths, self.capacity, _pack_obs(spans), metrics)),
+                self.queue_address)
+        except Exception:
+            # a lost report loses its piggybacked spans; put them back so
+            # the next report retries (bounded — the deque cap still holds)
+            self._span_outbox.extendleft(reversed(spans))
+            raise
 
     @property
     def batcher(self):
@@ -734,17 +945,39 @@ class VerifierWorker:
             return
         req: VerificationRequest = payload
         if not req.signatures:
-            self._reply(req, self._verify_host(req))
+            if req.trace:
+                t0_wall, t0 = time.time(), time.perf_counter()
+                error = self._verify_host(req)
+                span = make_span_dict(
+                    "worker.host_verify", tuple(req.trace), t0_wall,
+                    time.perf_counter() - t0, **self._span_tags())
+                self._reply(req, error, spans=(span,))
+            else:
+                self._reply(req, self._verify_host(req))
             return
         # device path: park on the stealable backlog; the feeder admits up
         # to max_inflight_groups into the batcher (non-blocking)
         with self._backlog_lock:
             self._backlog.append(req)
+            if req.trace:
+                self._arrival[req.verification_id] = time.time()
         self._feed()
+
+    def _span_tags(self) -> dict:
+        """Identity tags every worker-side span carries."""
+        tags = {"worker": self.network_service.my_address}
+        if self.device_shard:
+            tags["device_shard"] = list(self.device_shard)
+        return tags
 
     def _feed(self) -> None:
         """Admit backlog head-first into the batcher while the in-flight
-        window has room. Everything still on the backlog is stealable."""
+        window has room. Everything still on the backlog is stealable.
+
+        Traced requests grow a per-request span accumulator here: the
+        backlog-wait span closes on admission, a device-dispatch span opens
+        (its context handed to the batcher so in-process batcher spans nest
+        under it), and _complete_device finishes + ships the lot."""
         while True:
             with self._backlog_lock:
                 if (not self._backlog
@@ -755,8 +988,25 @@ class VerifierWorker:
                 req = self._backlog.popleft()
                 self._inflight_groups += 1
                 self._inflight_sigs += len(req.signatures)
+                arrived = self._arrival.pop(req.verification_id, None) \
+                    if req.trace else None
+            rt = None
+            ctx = None
+            if req.trace:
+                now_wall = time.time()
+                rt = {"spans": [], "t0": time.perf_counter()}
+                if arrived is not None:
+                    rt["spans"].append(make_span_dict(
+                        "worker.backlog_wait", tuple(req.trace), arrived,
+                        now_wall - arrived, **self._span_tags()))
+                rt["dispatch"] = make_span_dict(
+                    "worker.device_dispatch", tuple(req.trace), now_wall,
+                    0.0, n_sigs=len(req.signatures), **self._span_tags())
+                ctx = (rt["dispatch"]["trace_id"],
+                       rt["dispatch"]["span_id"])
             try:
-                group_future = self.batcher.submit_group(req.signatures)
+                group_future = self.batcher.submit_group(req.signatures,
+                                                         ctx=ctx)
             except Exception as e:
                 with self._backlog_lock:
                     self._inflight_groups -= 1
@@ -768,7 +1018,7 @@ class VerifierWorker:
                 self._pool = ThreadPoolExecutor(
                     max_workers=self._pool_workers,
                     thread_name_prefix="verifier-worker")
-            self._pool.submit(self._complete_device, req, group_future)
+            self._pool.submit(self._complete_device, req, group_future, rt)
 
     def _on_steal(self, steal: StealRequest) -> None:
         """Hand the backlog's TAIL back to the node (the feeder eats the
@@ -776,10 +1026,14 @@ class VerifierWorker:
         affinity already warmed the batcher). At most half the backlog goes;
         an empty return still acks the steal."""
         taken: list[VerificationRequest] = []
+        now_wall = time.time()
         with self._backlog_lock:
             limit = min(steal.max_items, (len(self._backlog) + 1) // 2)
             for _ in range(limit):
                 taken.append(self._backlog.pop())
+            arrivals = {r.verification_id:
+                        self._arrival.pop(r.verification_id, now_wall)
+                        for r in taken if r.trace}
         taken.reverse()
         try:
             self.network_service.send(
@@ -792,8 +1046,23 @@ class VerifierWorker:
             # still charged to us, so the node's detach path re-deals them
             with self._backlog_lock:
                 self._backlog.extendleft(reversed(taken))
+                for vid, t in arrivals.items():
+                    self._arrival[vid] = t
             log.warning("returning stolen work to %s failed",
                         self.queue_address, exc_info=True)
+            return
+        # the stolen requests never get a reply from US — their parked-time
+        # spans ride the next load report instead, tagged with the steal's
+        # own trace id as a cross-link
+        for r in taken:
+            if not r.trace:
+                continue
+            t_arr = arrivals.get(r.verification_id, now_wall)
+            self._span_outbox.append(make_span_dict(
+                "worker.stolen", tuple(r.trace), t_arr, now_wall - t_arr,
+                thief=steal.thief_address,
+                steal_trace=steal.trace[0] if steal.trace else None,
+                **self._span_tags()))
 
     def _verify_host(self, req: VerificationRequest) -> str | None:
         if req.transaction is None:
@@ -805,20 +1074,32 @@ class VerifierWorker:
             return str(e)
 
     def _complete_device(self, req: VerificationRequest,
-                         group_future) -> None:
+                         group_future, rt=None) -> None:
         error = None
         try:
             verdicts = group_future.result()
+            if rt is not None:
+                self._finish_dispatch_span(rt)
             for (key, _sig, _content), ok in zip(req.signatures, verdicts):
                 if not ok:
                     error = (f"Signature by {key.to_string_short()} did not "
                              f"verify")
                     break
             if error is None:
-                error = self._verify_host(req)
+                if rt is not None:
+                    h_wall, h0 = time.time(), time.perf_counter()
+                    error = self._verify_host(req)
+                    rt["spans"].append(make_span_dict(
+                        "worker.host_verify", tuple(req.trace), h_wall,
+                        time.perf_counter() - h0, **self._span_tags()))
+                else:
+                    error = self._verify_host(req)
         except Exception as e:
             error = str(e)
-        self._reply(req, error)
+            if rt is not None:
+                self._finish_dispatch_span(rt, error=error)
+        self._reply(req, error,
+                    spans=tuple(rt["spans"]) if rt is not None else ())
         with self._backlog_lock:
             self._inflight_groups -= 1
             self._inflight_sigs -= len(req.signatures)
@@ -837,7 +1118,30 @@ class VerifierWorker:
             except Exception:
                 log.warning("idle load report failed", exc_info=True)
 
-    def _reply(self, req: VerificationRequest, error: str | None) -> None:
+    def _finish_dispatch_span(self, rt: dict, error: str | None = None
+                              ) -> None:
+        """Close the device-dispatch span (duration = submit→result) and
+        tag it with any breaker that was open when the group resolved — the
+        breaker-reroute marker for host-fallback diagnosis."""
+        disp = rt.pop("dispatch", None)
+        if disp is None:
+            return
+        disp["duration_s"] = time.perf_counter() - rt["t0"]
+        if error is not None:
+            disp["tags"]["error"] = error
+        try:
+            status = getattr(self._batcher, "breaker_status", None)
+            if status is not None:
+                rerouted = sorted(n for n, st in status().items()
+                                  if st.get("state") != "closed")
+                if rerouted:
+                    disp["tags"]["breaker_rerouted"] = rerouted
+        except Exception:
+            pass
+        rt["spans"].append(disp)
+
+    def _reply(self, req: VerificationRequest, error: str | None,
+               spans: tuple = ()) -> None:
         if not self._alive:
             return   # killed mid-verify: the node requeues our outstanding work
         # a "drop" rule here models a worker crashing BETWEEN finishing the
@@ -851,7 +1155,8 @@ class VerifierWorker:
             self.verified_count += 1
         self.network_service.send(
             TopicSession(TOPIC_VERIFIER_RESPONSES),
-            serialize(VerificationResponse(req.verification_id, error)),
+            serialize(VerificationResponse(req.verification_id, error,
+                                           _pack_obs(list(spans)))),
             req.response_address)
 
     def stop(self, announce: bool = True) -> None:

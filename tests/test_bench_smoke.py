@@ -75,3 +75,33 @@ def test_bench_smoke_guard_gate_passes_end_to_end():
     assert "benchguard: ok" in proc.stderr
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["smoke"] is True
+
+
+def test_fleet_observability_fields_locked_in_guard_schema():
+    """The fleet artifact's observability fields are schema-locked: a
+    future bench.py edit that drops them must fail the guard, not just
+    vanish silently from the JSON."""
+    from corda_tpu.tools import benchguard
+    for field in ("worker_busy_skew_pct", "steals_total",
+                  "stitched_trace_depth"):
+        assert field in benchguard.MULTICHIP_REQUIRED
+        smoke = {"fleet_verifies_per_sec": 3.0, "smoke": True}
+        problems = benchguard.guard_multichip(smoke, [])
+        assert any(field in p for p in problems), field
+
+
+@pytest.mark.slow
+def test_fleet_smoke_guard_gate_passes_end_to_end():
+    """`bench.py --smoke --fleet --guard` must exit 0: smoke degrades the
+    MULTICHIP gate to its schema check, which now demands the fleet
+    observability fields."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--fleet", "--guard"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "benchguard: ok" in proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["smoke"] is True
+    assert out["stitched_trace_depth"] >= 2

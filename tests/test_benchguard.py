@@ -130,6 +130,8 @@ def _fleet(**over):
         "scaling_efficiency_pct": 92.0,
         "n_workers": 8, "n_devices": 8,
         "fleet_steals": 3, "fleet_stolen": 12,
+        "worker_busy_skew_pct": 4.0, "steals_total": 3,
+        "stitched_trace_depth": 4,
         "per_worker_sigs": {"w0": 4096, "w1": 4096},
     }
     base.update(over)

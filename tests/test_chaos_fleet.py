@@ -196,3 +196,67 @@ def test_steal_racing_requeue_resolves_exactly_once(bus, seed):
         w2.stop(announce=False)
     finally:
         svc.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_stolen_request_yields_one_stitched_trace(bus, seed):
+    """Observability under churn: a request stolen mid-flight must still
+    yield ONE stitched trace (node submit span + worker-side spans under
+    the same trace id) and its lifecycle timeline must carry exactly one
+    terminal resolution event — the steal hop adds events and spans, never
+    duplicates or orphans them."""
+    from corda_tpu.observability import Tracer, get_tracer, set_tracer
+    prev_tracer = get_tracer()
+    tracer = Tracer()
+    set_tracer(tracer)
+    svc = OutOfProcessTransactionVerifierService(bus.create_node("node"))
+    try:
+        w1 = _host_worker(bus, "w1")
+        bus.run_network()
+        checks = make_sig_checks(GROUP_SIZE, seed=seed)
+        futures = [svc.verify_signatures(checks) for _ in range(GROUPS)]
+        bus.run_network()          # all dealt to the only worker
+        w1.send_load_report()
+        bus.run_network()          # node sees the deep backlog
+
+        w2 = _host_worker(bus, "w2")
+        bus.run_network()
+        w2.send_load_report()      # idle report → steal from w1's backlog
+        bus.run_network()
+        _pump_until(bus, futures, workers=[w1, w2])
+        for f in futures:
+            assert f.result(timeout=1) is None
+        assert svc.metrics.meter("Fleet.Stolen").count >= 1
+        # flush the victim's worker.stolen span outbox onto a load report
+        w1.send_load_report()
+        bus.run_network()
+
+        timelines = svc.request_log.snapshot()
+        assert len(timelines) == len(futures)
+        stolen_vids = [int(vid) for vid, tl in timelines.items()
+                       if any(e["event"] == "stolen" for e in tl)]
+        assert stolen_vids, "no request recorded a steal hop"
+        for vid in (int(v) for v in timelines):
+            assert svc.request_log.terminal_count(vid) == 1, vid
+        # no leaked live submit spans either
+        assert svc._spans == {}
+        for vid in stolen_vids:
+            tl = timelines[str(vid)]
+            stolen_ev = next(e for e in tl if e["event"] == "stolen")
+            assert stolen_ev["victim"] == "w1"
+            trace_id = next(e["trace_id"] for e in tl if "trace_id" in e)
+            spans = tracer.trace(trace_id)
+            names = [s["name"] for s in spans]
+            assert names.count("verifier.oop_submit") == 1, names
+            assert any(n.startswith("worker.") for n in names), names
+            assert "worker.stolen" in names, names
+            # every span of the stolen request is stitched into ONE trace
+            assert {s["trace_id"] for s in spans} == {trace_id}
+    finally:
+        try:
+            w1.stop(announce=False)
+            w2.stop(announce=False)
+        except Exception:
+            pass
+        svc.shutdown()
+        set_tracer(prev_tracer)

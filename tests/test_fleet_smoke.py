@@ -24,6 +24,8 @@ def test_fleet_smoke_two_workers_share_the_run():
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     for field in ("fleet_verifies_per_sec", "scaling_efficiency_pct",
                   "n_workers", "n_devices", "fleet_steals", "fleet_stolen",
+                  "worker_busy_skew_pct", "steals_total",
+                  "stitched_trace_depth",
                   "groups", "group_size", "wall_s", "per_worker_sigs"):
         assert field in out, f"missing fleet JSON field: {field}"
     assert out["smoke"] is True and out["fleet"] is True
@@ -36,3 +38,13 @@ def test_fleet_smoke_two_workers_share_the_run():
     assert len(sigs) == 2 and all(c > 0 for c in sigs.values()), sigs
     # timed groups + the warm-up group all landed somewhere
     assert sum(sigs.values()) == (out["groups"] + 1) * out["group_size"]
+    # the observability plane saw the run: at least oop_submit →
+    # device_dispatch crossed the process seam under one trace id
+    assert out["stitched_trace_depth"] >= 2
+    assert 0 <= out["worker_busy_skew_pct"] <= 100
+    # smoke acceptance rode real HTTP: federated worker families on
+    # /metrics, a stitched cross-process trace on /traces, lifecycle
+    # timelines on /debug/requests
+    assert out["http_federated_families"] >= 1
+    assert out["http_stitched_traces"] >= 1
+    assert out["http_request_timelines"] >= 1

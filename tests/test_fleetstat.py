@@ -1,0 +1,52 @@
+"""fleetstat CLI: render() is a pure function of the two JSON payloads."""
+from corda_tpu.tools.fleetstat import render
+
+
+FLEET = {
+    "expected": 2, "attached": 2, "degraded": False, "stale": [],
+    "workers": {
+        "w0": {"device_shard": [0], "capacity": 1, "queue_depth": 3,
+               "last_report_age_s": 0.012, "stale": False},
+        "w1": {"device_shard": [1], "capacity": 2, "queue_depth": 0,
+               "last_report_age_s": 0.002, "stale": False},
+    },
+}
+
+METRICS = {
+    'SigBatcher.Checked{worker="w0"}': {
+        "type": "meter", "count": 128, "mean_rate": 40.0,
+        "family": "SigBatcher.Checked", "labels": {"worker": "w0"}},
+    'SigBatcher.Checked{worker="w1"}': {
+        "type": "meter", "count": 64, "mean_rate": 20.0,
+        "family": "SigBatcher.Checked", "labels": {"worker": "w1"}},
+    'SigBatcher.DeviceChecked{worker="w0"}': {
+        "type": "meter", "count": 96, "mean_rate": 30.0,
+        "family": "SigBatcher.DeviceChecked", "labels": {"worker": "w0"}},
+    "Fleet.agg.SigBatcher.Checked": {
+        "type": "meter", "count": 192, "mean_rate": 60.0},
+}
+
+
+def test_render_one_row_per_worker():
+    screen = render(FLEET, METRICS)
+    lines = screen.splitlines()
+    assert "2/2 attached" in lines[0]
+    assert "DEGRADED" not in lines[0]
+    w0 = next(l for l in lines if l.startswith("w0"))
+    w1 = next(l for l in lines if l.startswith("w1"))
+    assert "128" in w0 and "96" in w0 and "ok" in w0   # counts + fresh state
+    assert "64" in w1
+    assert "fleet aggregate checked: 192" in screen
+
+
+def test_render_flags_stale_and_degraded():
+    fleet = dict(FLEET, degraded=True, stale=["w0"])
+    screen = render(fleet, METRICS)
+    assert "DEGRADED" in screen.splitlines()[0]
+    w0 = next(l for l in screen.splitlines() if l.startswith("w0"))
+    assert "stale" in w0
+
+
+def test_render_survives_empty_payloads():
+    screen = render({}, {})
+    assert "no workers attached" in screen

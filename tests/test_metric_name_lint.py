@@ -1,0 +1,103 @@
+"""Prometheus exposition lint for every family the node can emit.
+
+Two invariants the scrape contract depends on:
+
+- every emitted metric family name — including the derived ``_count`` /
+  ``_bucket`` / quantile suffixes and the worker-federated labeled
+  families — matches the Prometheus metric-name grammar
+  ``[a-zA-Z_:][a-zA-Z0-9_:]*``, and every label name matches
+  ``[a-zA-Z_][a-zA-Z0-9_]*``;
+- hostile label VALUES (quotes, backslashes, newlines in a worker
+  address) survive ``prometheus_text``'s escaping: the exposition stays
+  line-parseable and the value round-trips through unescaping.
+"""
+import re
+
+from corda_tpu.observability import FleetMetricsFederation
+from corda_tpu.tools.webserver import prometheus_text
+from corda_tpu.utils.metrics import MetricRegistry
+
+NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+#: label="value" with only escaped backslash/quote/newline inside
+LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+VALUE = r"-?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|inf|nan)"
+SAMPLE = re.compile(
+    rf"^{NAME}(?:\{{{LABEL}(?:,{LABEL})*\}})? {VALUE}"
+    rf"(?: # \{{{LABEL}\}} {VALUE} [0-9]+\.[0-9]+)?$")
+HEADER = re.compile(rf"^# (?:HELP|TYPE) ({NAME}) .+$")
+
+HOSTILE_WORKERS = ('w"quote', "w\\back\\slash", "w\nnew\nline", "w-dash.dot")
+
+
+def _registry_with_every_type() -> MetricRegistry:
+    reg = MetricRegistry()
+    reg.meter("SigBatcher.DeviceChecked").mark(7)
+    with reg.timer("Verification.Duration"):
+        pass
+    reg.counter("Verification.InFlight").inc(2)
+    reg.settable_gauge("Batcher.PrepPool").set(3)
+    reg.gauge("Breaker.State.ed25519", lambda: 0)
+    h = reg.histogram("verifier.batch_size")
+    h.update(12, trace_id="abcdef0123456789")
+    h.update(512)
+    return reg
+
+
+def _federated_snapshot(reg: MetricRegistry) -> dict:
+    fed = FleetMetricsFederation()
+    worker_snap = _registry_with_every_type().snapshot()
+    for worker in HOSTILE_WORKERS:
+        fed.ingest(worker, worker_snap)
+    reg.add_collector(fed.snapshot)
+    return reg.snapshot()
+
+
+def test_every_family_and_label_matches_prometheus_grammar():
+    snap = _federated_snapshot(_registry_with_every_type())
+    text = prometheus_text(snap)
+    assert text.endswith("\n")
+    seen_type_headers: list = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = HEADER.match(line)
+            assert m, f"malformed header line: {line!r}"
+            if line.startswith("# TYPE"):
+                seen_type_headers.append(m.group(1))
+        else:
+            assert SAMPLE.match(line), f"malformed sample line: {line!r}"
+    # grouped rendering: one TYPE header per family, never one per worker
+    assert len(seen_type_headers) == len(set(seen_type_headers)), \
+        sorted(n for n in seen_type_headers
+               if seen_type_headers.count(n) > 1)
+
+
+def test_hostile_worker_label_values_survive_escaping():
+    text = prometheus_text(_federated_snapshot(MetricRegistry()))
+    # escaped forms present, raw (grammar-breaking) forms absent
+    assert 'worker="w\\"quote"' in text
+    assert 'worker="w\\\\back\\\\slash"' in text
+    assert 'worker="w\\nnew\\nline"' in text
+    assert 'worker="w-dash.dot"' in text
+    for line in text.splitlines():
+        # a raw newline in a label value would have split a sample line in
+        # two; every non-header line must still be a full sample
+        if line and not line.startswith("#"):
+            assert SAMPLE.match(line), f"escaping broke line: {line!r}"
+    # the escaped value unescapes back to the original worker address
+    m = re.search(r'worker="((?:[^"\\]|\\.)*)"', text)
+    assert m is not None
+
+
+def test_federated_families_render_under_worker_label():
+    """The acceptance shape: a worker's SigBatcher.* family appears on the
+    node exposition as a labeled sample of ONE family."""
+    text = prometheus_text(_federated_snapshot(MetricRegistry()))
+    fam = "corda_tpu_sigbatcher_devicechecked_count"
+    labeled = [l for l in text.splitlines()
+               if l.startswith(fam + "{") and 'worker="' in l]
+    assert len(labeled) >= len(HOSTILE_WORKERS)
+    assert text.count(f"# TYPE {fam} ") == 1
+    # fleet aggregate family rides along
+    assert "corda_tpu_fleet_agg_sigbatcher_devicechecked_count" in text

@@ -235,3 +235,73 @@ def test_load_aware_routing_prefers_idle_worker(bus):
     assert busy.verified_count == 0
     busy.stop()
     idle.stop()
+
+
+def test_submit_spans_finish_exactly_once_across_crash_requeue(bus):
+    """Regression: the node-side verifier.oop_submit span must finish
+    EXACTLY once per request even when the dealt worker crashes and the
+    share is requeued to a survivor — no leaked live spans in svc._spans,
+    no duplicate finished spans in the ring."""
+    from corda_tpu.observability import disable_tracing, enable_tracing
+    tracer = enable_tracing()
+    try:
+        node = bus.create_node("node")
+        svc = OutOfProcessTransactionVerifierService(node)
+        w1 = VerifierWorker(bus.create_node("w1"), "node")
+        w2 = VerifierWorker(bus.create_node("w2"), "node")
+        bus.run_network()
+        futures = [svc.verify(make_ltx(i)) for i in range(10)]
+        # w1 dies BEFORE pumping: its dealt share is requeued to w2
+        w1.stop(announce=False)
+        svc.queue.detach_worker("w1")
+        bus.run_network()
+        for f in futures:
+            assert f.result(timeout=1) is None
+        # every submit span finished exactly once, none leaked live
+        assert svc._spans == {}
+        submits = [s for s in tracer.ring.snapshot()
+                   if s["name"] == "verifier.oop_submit"]
+        assert len(submits) == len(futures)
+        assert all(s["duration_s"] > 0 for s in submits)
+        # the requeue left a lifecycle breadcrumb for the moved requests
+        moved = [vid for vid, tl in
+                 ((int(k), v) for k, v in svc.request_log.snapshot().items())
+                 if any(e["event"] == "requeued" for e in tl)]
+        assert moved, "no request recorded the worker-detached requeue"
+        for vid in moved:
+            assert svc.request_log.terminal_count(vid) == 1
+        w2.stop()
+    finally:
+        disable_tracing()
+
+
+def test_stale_worker_flagged_degraded(bus):
+    """A worker whose last load report is older than 3× the report
+    interval is flagged stale in fleet_status() — attached but possibly
+    wedged — and the fleet reads degraded (the /readyz surface)."""
+    import time
+    node = bus.create_node("node")
+    svc = OutOfProcessTransactionVerifierService(
+        node, expected_workers=1, load_report_interval_s=0.02)
+    w1 = VerifierWorker(bus.create_node("w1"), "node")
+    bus.run_network()
+    w1.send_load_report()
+    bus.run_network()
+
+    status = svc.fleet_status()
+    assert status["workers"]["w1"]["stale"] is False
+    assert status["workers"]["w1"]["last_report_age_s"] is not None
+    assert status["stale"] == [] and status["degraded"] is False
+
+    time.sleep(0.08)   # > 3× the 0.02s interval with no further report
+    status = svc.fleet_status()
+    assert status["workers"]["w1"]["stale"] is True
+    assert status["stale"] == ["w1"]
+    assert status["degraded"] is True
+
+    w1.send_load_report()   # a fresh report clears the flag
+    bus.run_network()
+    status = svc.fleet_status()
+    assert status["workers"]["w1"]["stale"] is False
+    assert status["degraded"] is False
+    w1.stop()

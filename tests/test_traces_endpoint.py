@@ -129,3 +129,40 @@ def test_metrics_endpoint_exposes_verifier_histograms():
                    "tx_verify_seconds"):
         for q in ("p50", "p90", "p99"):
             assert f"corda_tpu_{metric}_{q}" in text, (metric, q)
+
+
+def test_traces_endpoint_stitches_cross_process_fleet_trace(web):
+    """An out-of-process verification produces ONE trace whose spans come
+    from BOTH sides of the process seam — the node's verifier.oop_submit
+    and the worker's worker.* child spans — retrievable over /traces."""
+    import time
+    from corda_tpu.network.inmemory import InMemoryMessagingNetwork
+    from corda_tpu.verifier.fleet import make_sig_checks
+    from corda_tpu.verifier.out_of_process import (
+        OutOfProcessTransactionVerifierService, VerifierWorker)
+
+    enable_tracing()
+    bus = InMemoryMessagingNetwork()
+    svc = OutOfProcessTransactionVerifierService(bus.create_node("node"))
+    worker = VerifierWorker(bus.create_node("w1"), "node")
+    bus.run_network()
+    fut = svc.verify_signatures(make_sig_checks(4))
+    deadline = time.monotonic() + 60
+    while not fut.done():
+        bus.run_network()
+        time.sleep(0.005)
+        assert time.monotonic() < deadline, "verification did not resolve"
+    assert fut.result(timeout=1) is None
+
+    out = _get_json(web, "/traces")
+    assert out["enabled"] is True
+    stitched = [spans for spans in out["traces"].values()
+                if {"verifier.oop_submit", "worker.device_dispatch"}
+                <= {s["name"] for s in spans}]
+    assert stitched, "no stitched cross-process trace on /traces"
+    (spans,) = stitched
+    submit = next(s for s in spans if s["name"] == "verifier.oop_submit")
+    dispatch = next(s for s in spans if s["name"] == "worker.device_dispatch")
+    assert dispatch["parent_id"] == submit["span_id"]
+    assert dispatch["tags"]["worker"] == "w1"
+    worker.stop()

@@ -167,3 +167,43 @@ def test_retry_and_breaker_metric_families(web):
     finally:
         alice.services.verifier_service = None
         svc.shutdown()
+
+
+def test_debug_requests_empty_for_in_process_verifier(web):
+    network, alice, server = web
+    # in-process verifier keeps no request log: well-formed empty payload
+    assert _get(server, "/debug/requests") == {"requests": {}}
+
+
+def test_debug_requests_serves_request_log():
+    from corda_tpu.observability import RequestLog
+
+    class Ops:
+        def __init__(self):
+            self.log = RequestLog()
+
+        def request_timelines(self, limit=None):
+            return self.log.snapshot(limit=limit)
+
+    ops = Ops()
+    ops.log.append(1, "submitted", n_sigs=4)
+    ops.log.append(1, "routed", worker="w0", reason="least-loaded-rr",
+                   est_load={"w0": 0.0})
+    ops.log.append(1, "resolved", ok=True, worker="w0")
+    ops.log.append(2, "submitted", n_sigs=2)
+    server = NodeWebServer(ops).start()
+    try:
+        out = _get(server, "/debug/requests")
+        assert [e["event"] for e in out["requests"]["1"]] == [
+            "submitted", "routed", "resolved"]
+        assert out["requests"]["1"][1]["worker"] == "w0"
+        assert out["requests"]["1"][1]["reason"] == "least-loaded-rr"
+        # newest request first; limit caps the REQUEST count
+        limited = _get(server, "/debug/requests?limit=1")
+        assert list(limited["requests"]) == ["2"]
+        # malformed limit is the client's fault
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(server, "/debug/requests?limit=zap")
+        assert ei.value.code == 400
+    finally:
+        server.stop()
