@@ -31,6 +31,14 @@ Flags:
              fast enough for a tier-1 CPU test (tests/test_bench_smoke.py).
              Kernel-rate fields are emitted as 0.0 and "smoke": true is
              added; every other JSON field keeps its shape.
+  --ledger   end-to-end ledger scenario (observability/ledger_harness.py):
+             open-loop finance flows (issue → pay → DvP settle) against a
+             raft notary with the verifier service on the commit path;
+             emits the LEDGER_r0*.json fields (committed_tx_per_sec,
+             per-stage p50/p90/p99, SLO budget, chaos windows). The full
+             shape arms the chaos windows; with --smoke it is the tiny
+             CPU tier-1 shape, chaos off. Exactly-once / agreement /
+             stitched-trace violations exit 1 as BENCH INVALID.
   --guard    regression gate (corda_tpu.tools.benchguard): after printing
              the artifact, check it against floors fit from the repo's
              BENCH_r*.json trajectory (best-so-far minus a documented
@@ -63,6 +71,7 @@ from corda_tpu.ops import weierstrass as wc_ops
 SMOKE = "--smoke" in sys.argv
 GUARD = "--guard" in sys.argv
 FLEET = "--fleet" in sys.argv
+LEDGER = "--ledger" in sys.argv
 # smoke: small enough that every per-scheme drain stays below the batcher's
 # host_crossover (192) even when REPS groups coalesce into one flush
 BATCH = int(os.environ.get("CORDA_TPU_BENCH_N", 48 if SMOKE else 32768))
@@ -451,6 +460,53 @@ def fleet_main() -> None:
         print("benchguard: ok", file=sys.stderr)
 
 
+def ledger_main() -> None:
+    """--ledger: the end-to-end ledger scenario (ISSUE 10): open-loop
+    finance flows against the raft notary with the TPU verifier on the
+    commit path. Smoke: tiny workload, chaos off, every signature batch
+    under the host crossover — CPU tier-1 safe. Full: the measured shape
+    with the chaos windows armed. Emits the LEDGER_r0*.json fields; the
+    exactly-once and replica-agreement invariants are validity probes
+    (BENCH INVALID), not guarded floors — a run that double-spends is
+    wrong, not slow."""
+    from corda_tpu.observability.ledger_harness import (LedgerScenarioConfig,
+                                                        run_ledger_scenario)
+    cfg = LedgerScenarioConfig() if SMOKE \
+        else LedgerScenarioConfig.full(chaos=True)
+    out = run_ledger_scenario(cfg)
+    out.pop("trace_sample", None)   # test hook, not an artifact field
+    out["ledger"] = True
+    if SMOKE:
+        out["smoke"] = True
+    print(json.dumps(out))
+    problems = []
+    if not out["exactly_once_ok"]:
+        problems.append("exactly-once violated: an accepted transaction's "
+                        "inputs are not all consumed by that transaction "
+                        "on every replica")
+    if not out["replicas_agree"]:
+        problems.append("raft replicas diverged at quiescence")
+    if out["stitched_traces"] < 1:
+        problems.append("no connected flow.run→vault.update trace "
+                        "(commit-path span stitching broken)")
+    if out["ops_committed"] <= 0:
+        problems.append("no operation committed")
+    if problems:
+        for p in problems:
+            print(f"BENCH INVALID: {p}", file=sys.stderr)
+        sys.exit(1)
+    if GUARD:
+        from corda_tpu.tools.benchguard import guard_ledger
+        failures = guard_ledger(out)
+        if failures:
+            print("BENCH REGRESSION: ledger metrics breached their "
+                  "trajectory floors:", file=sys.stderr)
+            for p in failures:
+                print(f"  {p}", file=sys.stderr)
+            sys.exit(1)
+        print("benchguard: ok", file=sys.stderr)
+
+
 def main() -> None:
     from corda_tpu.observability import get_profiler
     from corda_tpu.verifier.batcher import SignatureBatcher
@@ -555,4 +611,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    fleet_main() if FLEET else main()
+    if FLEET:
+        fleet_main()
+    elif LEDGER:
+        ledger_main()
+    else:
+        main()

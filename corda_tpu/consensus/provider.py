@@ -8,6 +8,7 @@ surfacing) live.
 from __future__ import annotations
 
 import concurrent.futures
+import time as _time
 
 from ..node.notary import UniquenessException
 from ..utils import retry
@@ -18,7 +19,7 @@ class _LeaderUnknown(RuntimeError):
 
 
 def consensus_commit(backend, states, tx_id, caller: str,
-                     timeout_s: float) -> None:
+                     timeout_s: float, trace_ctx=None, metrics=None) -> None:
     """Submit a put_all to `backend` (RaftNode or BFTClient) and block until
     the replicated state machine answers; abandon the pending entry on
     timeout so the request table cannot leak.
@@ -27,10 +28,21 @@ def consensus_commit(backend, states, tx_id, caller: str,
     as ``RuntimeError("no raft leader known")`` from submit() — that is
     transient by construction, so the submission retries with
     decorrelated-jitter backoff inside the caller's timeout budget
-    instead of failing the whole notarisation."""
+    instead of failing the whole notarisation.
 
-    def _submit():
-        fut = backend.submit(("put_all", [tx_id, list(states), caller]))
+    ``trace_ctx`` parents a ``raft.commit`` span over the whole blocking
+    round (retries included) and threads into backend.submit's own
+    ``raft.submit`` spans when the backend supports it; ``metrics`` (a
+    MetricRegistry, optional) receives the ``raft_commit_seconds``
+    commit-path stage histogram."""
+    from ..observability import get_tracer
+
+    def _submit(ctx):
+        kwargs = {}
+        if getattr(backend, "supports_trace_ctx", False):
+            kwargs["trace_ctx"] = ctx
+        fut = backend.submit(("put_all", [tx_id, list(states), caller]),
+                             **kwargs)
         try:
             return fut.result(timeout=timeout_s)
         except concurrent.futures.TimeoutError:
@@ -43,10 +55,21 @@ def consensus_commit(backend, states, tx_id, caller: str,
                 raise _LeaderUnknown(str(e)) from e
             raise
 
-    result = retry.retry_call(
-        _submit, site="raft.submit",
-        policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5, max_attempts=6,
-                                 deadline_s=timeout_s),
-        retry_on=(_LeaderUnknown,))
+    with get_tracer().span("raft.commit", parent=trace_ctx,
+                           n_states=len(states), caller=caller) as sp:
+        ctx = sp.context() or trace_ctx
+        t0 = _time.perf_counter()
+        try:
+            result = retry.retry_call(
+                lambda: _submit(ctx), site="raft.submit",
+                policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5,
+                                         max_attempts=6,
+                                         deadline_s=timeout_s),
+                retry_on=(_LeaderUnknown,))
+        finally:
+            if metrics is not None:
+                trace_id = getattr(ctx, "trace_id", None)
+                metrics.histogram("raft_commit_seconds").update(
+                    _time.perf_counter() - t0, trace_id=trace_id)
     if not result["committed"]:
         raise UniquenessException(result["conflicts"])

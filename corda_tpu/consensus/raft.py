@@ -251,6 +251,10 @@ class RaftNode:
             self.state.term_at(prev), entries, self.state.commit_index))
 
     # -- client submission ---------------------------------------------------
+    #: consensus_commit threads the notary's span context through submit()
+    #: when this flag is set (NativeRaftNode / BFTClient don't take it yet)
+    supports_trace_ctx = True
+
     def submit(self, entry, trace_ctx=None) -> Future:
         """Replicate `entry`; the future resolves with apply_fn's result once
         committed. On a follower, forwards to the known leader. The caller

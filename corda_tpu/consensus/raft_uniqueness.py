@@ -88,6 +88,13 @@ class RaftUniquenessProvider(UniquenessProvider):
         provider.state_machine = sm
         return provider
 
-    def commit(self, states, tx_id, caller: str) -> None:
+    #: NotaryService.commit passes its notary.uniqueness span context (and
+    #: the node's metric registry) through when the provider advertises it —
+    #: same capability-flag pattern as the verifier service.
+    supports_trace_ctx = True
+
+    def commit(self, states, tx_id, caller: str, trace_ctx=None,
+               metrics=None) -> None:
         from .provider import consensus_commit
-        consensus_commit(self.raft, states, tx_id, caller, self.timeout_s)
+        consensus_commit(self.raft, states, tx_id, caller, self.timeout_s,
+                         trace_ctx=trace_ctx, metrics=metrics)

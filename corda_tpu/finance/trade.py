@@ -68,6 +68,12 @@ class SellerFlow(FlowLogic):
             return ptx
 
         ptx = resp.unwrap(validate)
+        # resolve the buyer's cash chain from the buyer before signing —
+        # the seller finalises, so a validating notary resolves the swap's
+        # FULL dependency graph from the seller (TwoPartyTradeFlow.kt's
+        # SignTransactionFlow performs exactly this resolution)
+        yield from self.sub_flow(ResolveTransactionsFlow(
+            self.buyer, stx=ptx))
         stx = ptx.plus(hub.sign(ptx.id.bytes, me.owning_key))
         final = yield from self.sub_flow(FinalityFlow(stx, [self.buyer]))
         return final

@@ -381,13 +381,26 @@ class FinalityFlow(FlowLogic):
         self.extra_recipients = tuple(extra_recipients)
 
     def call(self):
+        import time as _time
         hub = self.service_hub
         stx = self.stx
         needs_notary = stx.notary is not None and (
             len(stx.inputs) > 0 or stx.tx.time_window is not None)
         if needs_notary:
+            # client-observed notarisation round trip (request → notary
+            # uniqueness/raft commit → signature back) — the commit path's
+            # dominant wait, so it gets its own node histogram alongside
+            # the notary-side notary_uniqueness_seconds stage
+            t0 = _time.perf_counter()
             notary_sigs = yield from self.sub_flow(NotaryFlow(stx))
             stx = stx.plus(*notary_sigs)
+            monitoring = getattr(hub, "monitoring", None)
+            if monitoring is not None:
+                sm = getattr(self, "state_machine", None)
+                ctx = getattr(sm, "trace_ctx", None)
+                monitoring.histogram("notarise_seconds").update(
+                    _time.perf_counter() - t0,
+                    trace_id=getattr(ctx, "trace_id", None))
         hub.record_transactions(stx)
         participants = self._participant_parties(stx)
         yield from self.sub_flow(

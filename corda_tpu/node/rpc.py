@@ -272,6 +272,14 @@ class CordaRPCOps:
         else:
             # non-notary node: ready means it can REACH a notary
             checks["notary_known"] = bool(self.notary_identities())
+        slo = getattr(self.hub, "slo_tracker", None)
+        if slo is not None:
+            # burn-rate alert = DEGRADED, not unready: the node still
+            # commits, but it is eating its error budget — operators get
+            # the per-objective budget/burn picture right on /readyz
+            status = slo.status()
+            if status["alerting"]:
+                degraded["slo"] = status
         out = {"ready": all(checks.values()), "checks": checks}
         if degraded:
             out["degraded"] = degraded

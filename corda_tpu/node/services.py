@@ -311,6 +311,10 @@ class ServiceHub:
         # set by NotaryService.__init__ on notary nodes; the readiness
         # probe checks its commit-log backend
         self.notary_service = None
+        # optional observability/slo.SLOTracker — /readyz surfaces its
+        # burn-rate alerts as degraded.slo (set by the ledger harness or
+        # an operator wiring SLOs onto a node)
+        self.slo_tracker = None
         from .audit import InMemoryAuditService
         self.audit = InMemoryAuditService()
         self.storage = TransactionStorage()
@@ -369,20 +373,36 @@ class ServiceHub:
 
     # -- ledger recording (ServiceHub.recordTransactions) --------------------
     def record_transactions(self, *stxs) -> None:
+        import time as _time
+
+        from ..observability import get_tracer
         # vault updates land before ledger-commit waiters wake, so a resumed
         # flow observes a consistent vault (HibernateObserver ordering analog)
         fresh = [stx for stx in stxs
                  if self.storage.add_transaction(stx, notify=False)]
         if fresh:
-            self.vault.notify_all(fresh)
-            for stx in fresh:
-                self.storage.notify_listeners(stx)
+            smm = getattr(self, "smm", None)
+            fsm = smm.current_fsm if smm is not None else None
+            ctx = getattr(fsm, "trace_ctx", None)
+            # vault.update: the last commit-path stage — consumed/produced
+            # bookkeeping plus observer fan-out, under the recording flow's
+            # trace so /traces shows flow.run → ... → vault.update whole
+            with get_tracer().span("vault.update", parent=ctx,
+                                   n_txs=len(fresh)) as sp:
+                t0 = _time.perf_counter()
+                try:
+                    self.vault.notify_all(fresh)
+                    for stx in fresh:
+                        self.storage.notify_listeners(stx)
+                finally:
+                    trace_id = getattr(sp.context() or ctx, "trace_id", None)
+                    self.monitoring.histogram("vault_update_seconds").update(
+                        _time.perf_counter() - t0, trace_id=trace_id)
             # flow → transaction mapping for the RPC mapping feed
             # (StateMachineRecordedTransactionMapping)
-            smm = getattr(self, "smm", None)
-            if smm is not None and smm.current_fsm is not None:
+            if smm is not None and fsm is not None:
                 for stx in fresh:
-                    smm.record_tx_mapping(smm.current_fsm.run_id, stx.id)
+                    smm.record_tx_mapping(fsm.run_id, stx.id)
 
     # -- signing -------------------------------------------------------------
     def sign(self, content: bytes, key: PublicKey | None = None

@@ -231,6 +231,9 @@ class StateMachineManager:
         return fsm
 
     def _register(self, fsm: FlowStateMachine) -> None:
+        # wall-clock anchor for the flow_run commit-path stage histogram
+        # (observability/stages.LEDGER_STAGE_METRICS), closed in _finalize
+        fsm.started_at = _time.perf_counter()
         monitoring = getattr(self.hub, "monitoring", None)
         if monitoring is not None:   # Flows.StartedPerSecond analog
             monitoring.meter("Flows.Started").mark()
@@ -772,6 +775,11 @@ class StateMachineManager:
         if monitoring is not None and fsm.run_id in self.flows:
             monitoring.meter("Flows.Finished").mark()
             monitoring.counter("Flows.InFlight").dec()
+            started = getattr(fsm, "started_at", None)
+            if started is not None:
+                trace_id = getattr(fsm.trace_ctx, "trace_id", None)
+                monitoring.histogram("flow_run_seconds").update(
+                    _time.perf_counter() - started, trace_id=trace_id)
         # crash-consistency seam: a "drop" rule here models a process kill
         # AFTER the flow's sends went out but BEFORE the checkpoint was
         # removed — the surviving artifact of exactly that crash window.

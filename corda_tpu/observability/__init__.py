@@ -17,6 +17,10 @@ Three pieces (docs/OBSERVABILITY.md):
 - federation.py — node-side accumulator for worker metric snapshots
   (per-worker labeled families + Fleet.agg.* merges on /metrics).
 - lifecycle.py — bounded per-request event timelines (/debug/requests).
+- slo.py — availability/latency objectives, error budgets, multi-window
+  burn-rate alerts (surfaced on /readyz as ``degraded.slo``).
+- ledger_harness.py — open-loop end-to-end commit-path load scenario
+  (bench.py --ledger / tools/scenario.py).
 
 The Histogram metric type itself lives in utils/metrics.py with the rest
 of the registry.
@@ -27,15 +31,19 @@ from .profiling import (KernelProfiler, OverlapTracker, get_profiler,
                         set_profiler)
 from .ring import SpanRing
 from .slog import jlog
-from .stages import STAGE_METRICS, stage_percentiles
+from .slo import DEFAULT_OBJECTIVES, SLObjective, SLOTracker
+from .stages import (LEDGER_STAGE_METRICS, STAGE_METRICS,
+                     ledger_stage_percentiles, stage_percentiles)
 from .tracing import (NOOP_SPAN, NOOP_TRACER, NoopTracer, Span, SpanContext,
                       Tracer, disable_tracing, enable_tracing, get_tracer,
                       make_span_dict, set_tracer)
 
 __all__ = [
-    "FleetMetricsFederation", "KernelProfiler", "NOOP_SPAN", "NOOP_TRACER",
-    "NoopTracer", "OverlapTracker", "RequestLog", "Span", "SpanContext",
-    "SpanRing", "STAGE_METRICS", "Tracer", "disable_tracing",
-    "enable_tracing", "get_profiler", "get_tracer", "jlog", "make_span_dict",
-    "set_profiler", "set_tracer", "stage_percentiles",
+    "DEFAULT_OBJECTIVES", "FleetMetricsFederation", "KernelProfiler",
+    "LEDGER_STAGE_METRICS", "NOOP_SPAN", "NOOP_TRACER", "NoopTracer",
+    "OverlapTracker", "RequestLog", "SLObjective", "SLOTracker", "Span",
+    "SpanContext", "SpanRing", "STAGE_METRICS", "Tracer", "disable_tracing",
+    "enable_tracing", "get_profiler", "get_tracer", "jlog",
+    "ledger_stage_percentiles", "make_span_dict", "set_profiler",
+    "set_tracer", "stage_percentiles",
 ]

@@ -43,6 +43,9 @@ class RequestLog:
         self.capacity = capacity
         self._lock = threading.Lock()
         self._timelines: "OrderedDict[int, list[dict]]" = OrderedDict()
+        #: vids whose timeline already carries a TERMINAL_EVENTS event, in
+        #: the order they resolved — the eviction queue of first resort.
+        self._terminal: "OrderedDict[int, None]" = OrderedDict()
         self.dropped = 0   # whole timelines evicted by the bound
 
     def append(self, vid: int, event: str, trace=None, **fields) -> None:
@@ -57,11 +60,27 @@ class RequestLog:
             tl = self._timelines.get(vid)
             if tl is None:
                 while len(self._timelines) >= self.capacity:
-                    self._timelines.popitem(last=False)
-                    self.dropped += 1
+                    self._evict_one_locked()
                 tl = self._timelines[vid] = []
             tl.append(rec)
+            if event in TERMINAL_EVENTS:
+                self._terminal[vid] = None
         jlog(log, f"request.{event}", ctx=trace, vid=vid, **fields)
+
+    def _evict_one_locked(self) -> None:
+        """Evict one whole timeline, preferring requests that already
+        resolved. Blind FIFO eviction could drop an in-flight request
+        while resolved ones inserted later survive; its later events
+        would then re-open a fresh partial timeline, leaking an extra
+        entry per churn cycle and losing the routing history the debug
+        surface exists for."""
+        while self._terminal:
+            vid, _ = self._terminal.popitem(last=False)
+            if self._timelines.pop(vid, None) is not None:
+                self.dropped += 1
+                return
+        self._timelines.popitem(last=False)
+        self.dropped += 1
 
     def timeline(self, vid: int) -> list[dict]:
         with self._lock:

@@ -25,6 +25,20 @@ STAGE_METRICS = {
     "finish": "verifier_finish_seconds",
 }
 
+#: Ledger commit-path stages (ISSUE 10): the span tree a committed
+#: transaction leaves behind, as Histograms on the owning node's
+#: ``hub.monitoring`` registry. flow_run lands in statemachine._finalize,
+#: tx_verify in verifier/service.py, notary_uniqueness in node/notary.py,
+#: raft_commit in consensus/provider.py, vault_update in
+#: node/services.record_transactions.
+LEDGER_STAGE_METRICS = {
+    "flow_run": "flow_run_seconds",
+    "tx_verify": "tx_verify_seconds",
+    "notary_uniqueness": "notary_uniqueness_seconds",
+    "raft_commit": "raft_commit_seconds",
+    "vault_update": "vault_update_seconds",
+}
+
 _QUANTS = ("p50", "p90", "p99")
 
 
@@ -45,4 +59,19 @@ def stage_percentiles(snapshot: dict) -> dict:
     if sizes and sizes.get("count"):
         for q in _QUANTS:
             out[f"verifier_batch_size_{q}"] = round(sizes[q], 1)
+    return out
+
+
+def ledger_stage_percentiles(snapshot: dict) -> dict:
+    """Flatten the commit-path stage histograms into LEDGER artifact
+    fields: ``ledger_stage_<stage>_ms_<q>``. Same omission rule as
+    stage_percentiles — a stage with no samples (e.g. raft_commit on an
+    in-memory notary) stays absent, meaning "never ran"."""
+    out: dict = {}
+    for stage, metric in LEDGER_STAGE_METRICS.items():
+        fields = snapshot.get(metric)
+        if not fields or not fields.get("count"):
+            continue
+        for q in _QUANTS:
+            out[f"ledger_stage_{stage}_ms_{q}"] = round(fields[q] * 1000.0, 4)
     return out

@@ -303,6 +303,127 @@ def guard_multichip(current: dict,
     return problems
 
 
+# ---------------------------------------------------------------------------
+# LEDGER (end-to-end scenario) trajectory
+# ---------------------------------------------------------------------------
+
+#: Ledger-scenario metrics locked from the LEDGER trajectory. The headline
+#: commit rate gets the rate tolerance; the double-spend-check tail gets
+#: the tail tolerance (a p99 over one run's uniqueness commits is a single
+#: worst consensus round — one re-election doubles it).
+LEDGER_GUARDED: dict = {
+    "committed_tx_per_sec": ("higher", RATE_TOLERANCE),
+    "notary_uniqueness_p99_ms": ("lower", TAIL_TOLERANCE),
+}
+
+#: Fields every LEDGER artifact must carry (the --smoke --ledger schema
+#: gate). The per-stage percentiles prove the commit-path attribution is
+#: wired end to end; exactly_once_ok / replicas_agree are the invariant
+#: self-report; slo_error_budget_pct + chaos_windows tie the SLO tracker
+#: and the fault schedule into the artifact.
+LEDGER_REQUIRED: tuple = (
+    "metric", "value", "unit", "committed_tx_per_sec",
+    "offered_tx_per_sec", "parties", "raft_replicas",
+    "ops_total", "ops_committed", "ops_failed", "notarised_tx_count",
+    "duration_s", "e2e_ms_p50", "e2e_ms_p90", "e2e_ms_p99",
+    "ledger_stage_flow_run_ms_p99", "ledger_stage_tx_verify_ms_p99",
+    "ledger_stage_notary_uniqueness_ms_p99",
+    "ledger_stage_raft_commit_ms_p99", "ledger_stage_vault_update_ms_p99",
+    "notary_uniqueness_p99_ms", "slo_error_budget_pct",
+    "chaos_enabled", "chaos_windows",
+    "exactly_once_ok", "replicas_agree", "stitched_traces",
+)
+
+#: required fields that are NOT numbers (shape-checked individually)
+_LEDGER_FIELD_TYPES: dict = {
+    "metric": str, "unit": str,
+    "chaos_enabled": bool, "exactly_once_ok": bool, "replicas_agree": bool,
+    "chaos_windows": list,
+}
+
+
+def ledger_trajectory_paths(root: str | None = None) -> list[str]:
+    root = root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return sorted(_glob.glob(os.path.join(root, "LEDGER_r*.json")))
+
+
+def ledger_schema_violations(current: dict) -> list[str]:
+    problems = []
+    for name in LEDGER_REQUIRED:
+        if name not in current:
+            problems.append(f"missing required ledger field {name!r}")
+            continue
+        want = _LEDGER_FIELD_TYPES.get(name)
+        if want is not None:
+            if not isinstance(current[name], want):
+                problems.append(
+                    f"{name} should be a {want.__name__}, got "
+                    f"{type(current[name]).__name__}")
+        elif (isinstance(current[name], bool)
+              or not isinstance(current[name], (int, float))):
+            problems.append(f"{name} should be a number, got "
+                            f"{type(current[name]).__name__}")
+    return problems
+
+
+def fit_ledger_guards(trajectory: list[dict]) -> dict:
+    """Best-so-far guards over the full-run LEDGER entries (smoke rounds
+    contribute nothing; zero values mean the stage never ran)."""
+    guards: dict = {}
+    for run in trajectory:
+        if run is None or run.get("smoke"):
+            continue
+        for name, (direction, tol) in LEDGER_GUARDED.items():
+            v = run.get(name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+                continue
+            g = guards.get(name)
+            best = v if g is None else (
+                max(g["best"], v) if direction == "higher"
+                else min(g["best"], v))
+            guards[name] = {
+                "best": best,
+                "bound": best * (1 - tol) if direction == "higher"
+                         else best * (1 + tol),
+                "direction": direction,
+                "tolerance": tol,
+            }
+    return guards
+
+
+def guard_ledger(current: dict,
+                 trajectory_paths: list[str] | None = None) -> list[str]:
+    """The ledger gate: schema always; value floors unless smoke. Used by
+    ``bench.py --ledger --guard`` and by the driver on the LEDGER
+    artifact."""
+    current = parse_artifact(current)
+    problems = ledger_schema_violations(current)
+    if current.get("smoke"):
+        return problems
+    paths = (ledger_trajectory_paths() if trajectory_paths is None
+             else trajectory_paths)
+    runs = []
+    for path in sorted(paths):
+        with open(path, encoding="utf-8") as f:
+            runs.append(parse_artifact(json.load(f)))
+    for name, g in sorted(fit_ledger_guards(runs).items()):
+        v = current.get(name)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if g["direction"] == "higher" and v < g["bound"]:
+            problems.append(
+                f"{name}: {v:g} < floor {g['bound']:.4g} "
+                f"(best {g['best']:g} - {g['tolerance']:.0%} tolerance; "
+                f"higher is better)")
+        elif g["direction"] == "lower" and v > g["bound"]:
+            problems.append(
+                f"{name}: {v:g} > ceiling {g['bound']:.4g} "
+                f"(best {g['best']:g} + {g['tolerance']:.0%} tolerance; "
+                f"lower is better)")
+    return problems
+
+
 def guard_current(current: dict, trajectory_paths: list[str] | None = None
                   ) -> list[str]:
     """The bench.py --guard entry: fit guards from the repo trajectory and

@@ -40,6 +40,8 @@ def _worker_counts(metrics: dict, worker: str) -> dict:
     """Pull the federated count fields for one worker out of a node
     /api/metrics payload (keys look like ``Family{worker="w0"}``)."""
     out = {}
+    if not isinstance(metrics, dict):
+        return out
     suffix = f'{{worker="{worker}"}}'
     for family, label in _RATE_FAMILIES:
         fields = metrics.get(family + suffix)
@@ -50,29 +52,49 @@ def _worker_counts(metrics: dict, worker: str) -> dict:
     return out
 
 
+def _cell(value, default):
+    """A value safe to width-format: numbers and strings pass through,
+    anything else (None, nested junk from a half-written payload)
+    collapses to ``default``."""
+    if isinstance(value, bool) or not isinstance(value, (int, float, str)):
+        return default
+    return value
+
+
 def render(fleet: dict, metrics: dict) -> str:
     """One screenful: fleet header + a row per worker. Pure function of
-    the two JSON payloads."""
-    workers = fleet.get("workers") or {}
-    stale = set(fleet.get("stale") or ())
+    the two JSON payloads — tolerates empty and malformed ones (a worker
+    that crashed mid-report can leave non-dict entries behind)."""
+    if not isinstance(fleet, dict):
+        fleet = {}
+    if not isinstance(metrics, dict):
+        metrics = {}
+    workers = fleet.get("workers")
+    if not isinstance(workers, dict):
+        workers = {}
+    stale = fleet.get("stale")
+    stale = set(stale) if isinstance(stale, (list, tuple, set)) else set()
     lines = [
         "verifier fleet: "
-        f"{fleet.get('attached', 0)}/{fleet.get('expected') or '?'} attached"
+        f"{_cell(fleet.get('attached'), 0)}"
+        f"/{_cell(fleet.get('expected'), 0) or '?'} attached"
         + ("  DEGRADED" if fleet.get("degraded") else "")
         + (f"  stale={sorted(stale)}" if stale else ""),
         f"{'WORKER':<14}{'STATE':<10}{'AGE(s)':>8}{'DEPTH':>7}{'CAP':>5}"
         f"{'CHECKED':>10}{'DEV_CHK':>10}{'BATCHES':>9}{'TRIPS':>7}",
     ]
-    for name in sorted(workers):
+    for name in sorted(workers, key=str):
         w = workers[name]
+        if not isinstance(w, dict):
+            w = {}
         age = w.get("last_report_age_s")
         counts = _worker_counts(metrics, name)
         lines.append(
-            f"{name:<14}"
+            f"{str(name):<14}"
             f"{'stale' if (name in stale or w.get('stale')) else 'ok':<10}"
-            f"{age if age is not None else '-':>8}"
-            f"{w.get('queue_depth', 0):>7}"
-            f"{w.get('capacity', 1):>5}"
+            f"{_cell(age, '-'):>8}"
+            f"{_cell(w.get('queue_depth'), 0):>7}"
+            f"{_cell(w.get('capacity'), 1):>5}"
             f"{counts.get('checked', 0):>10}"
             f"{counts.get('dev_checked', 0):>10}"
             f"{counts.get('batches', 0):>9}"

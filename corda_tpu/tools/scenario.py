@@ -1,0 +1,69 @@
+"""CLI driver for the ledger scenario harness.
+
+Runs the open-loop finance workload (observability/ledger_harness.py)
+against the in-process raft-notary topology and prints the LEDGER
+report as JSON — the same fields ``bench.py --ledger`` emits into
+``LEDGER_r0*.json``, for interactive use:
+
+    python -m corda_tpu.tools.scenario                  # smoke shape
+    python -m corda_tpu.tools.scenario --full --chaos   # measured shape
+    python -m corda_tpu.tools.scenario --parties 12 --ops 120 --rate 20
+
+Exit status is non-zero when the run violated the ledger invariant
+(exactly-once / replica agreement) so CI can gate on it directly.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_config(argv=None):
+    from ..observability.ledger_harness import LedgerScenarioConfig
+
+    ap = argparse.ArgumentParser(
+        prog="corda_tpu.tools.scenario",
+        description="open-loop ledger scenario runner")
+    ap.add_argument("--full", action="store_true",
+                    help="measured shape (24 parties, 240 ops) instead of "
+                         "the CPU smoke shape")
+    ap.add_argument("--chaos", action="store_true",
+                    help="arm the partition / leader-kill / append-drop "
+                         "fault windows")
+    ap.add_argument("--parties", type=int, default=None)
+    ap.add_argument("--ops", type=int, default=None,
+                    help="total operations (issue ops included)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="offered operations per second (open loop)")
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="uniqueness-provider commit timeout (seconds)")
+    args = ap.parse_args(argv)
+
+    cfg = LedgerScenarioConfig.full(chaos=args.chaos) if args.full \
+        else LedgerScenarioConfig(chaos=args.chaos)
+    if args.parties is not None:
+        cfg.parties = args.parties
+    if args.ops is not None:
+        cfg.operations = args.ops
+    if args.rate is not None:
+        cfg.rate_tx_per_sec = args.rate
+    if args.seed is not None:
+        cfg.seed = args.seed
+    if args.timeout is not None:
+        cfg.provider_timeout_s = args.timeout
+    return cfg
+
+
+def main(argv=None) -> int:
+    from ..observability.ledger_harness import run_ledger_scenario
+
+    report = run_ledger_scenario(build_config(argv))
+    print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    ok = report["exactly_once_ok"] and report["replicas_agree"]
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

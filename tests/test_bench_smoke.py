@@ -105,3 +105,31 @@ def test_fleet_smoke_guard_gate_passes_end_to_end():
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["smoke"] is True
     assert out["stitched_trace_depth"] >= 2
+
+
+@pytest.mark.ledger
+def test_ledger_smoke_guard_gate_passes_end_to_end():
+    """`bench.py --smoke --ledger --guard` is the tier-1 CPU proof for the
+    whole ledger measurement path: the open-loop scenario completes, the
+    artifact carries every LEDGER_r0*.json field, the validity probes
+    (exactly-once, replica agreement, stitched traces) hold, and the
+    guard degrades to its schema check on the smoke artifact."""
+    from corda_tpu.tools.benchguard import LEDGER_REQUIRED
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke",
+         "--ledger", "--guard"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "benchguard: ok" in proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    for field in LEDGER_REQUIRED:
+        assert field in out, f"missing LEDGER field: {field}"
+    assert out["smoke"] is True and out["ledger"] is True
+    assert out["exactly_once_ok"] is True
+    assert out["replicas_agree"] is True
+    assert out["stitched_traces"] >= 1
+    assert out["ops_failed"] == 0
+    assert out["committed_tx_per_sec"] > 0
+    assert out["chaos_enabled"] is False and out["chaos_windows"] == []
+    assert "trace_sample" not in out      # test hook stays out of artifacts

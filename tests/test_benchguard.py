@@ -186,3 +186,77 @@ def test_multichip_real_trajectory_accepts_historical_artifacts():
     if not paths:
         pytest.skip("no MULTICHIP_r*.json artifacts in this checkout")
     assert benchguard.guard_multichip(_fleet(), paths) == []
+
+
+# ---------------------------------------------------------------------------
+# LEDGER (end-to-end ledger scenario) gate
+
+
+def _ledger(**over):
+    base = {
+        "metric": "committed_tx_per_sec", "value": 10.0, "unit": "tx/s",
+        "committed_tx_per_sec": 10.0, "offered_tx_per_sec": 40.0,
+        "parties": 24, "raft_replicas": 3,
+        "ops_total": 240, "ops_committed": 230, "ops_failed": 10,
+        "notarised_tx_count": 158, "duration_s": 24.5,
+        "e2e_ms_p50": 8300.0, "e2e_ms_p90": 15000.0, "e2e_ms_p99": 18000.0,
+        "ledger_stage_flow_run_ms_p99": 500.0,
+        "ledger_stage_tx_verify_ms_p99": 20.0,
+        "ledger_stage_notary_uniqueness_ms_p99": 100.0,
+        "ledger_stage_raft_commit_ms_p99": 90.0,
+        "ledger_stage_vault_update_ms_p99": 5.0,
+        "notary_uniqueness_p99_ms": 100.0,
+        "slo_error_budget_pct": 0.0,
+        "chaos_enabled": True, "chaos_windows": [],
+        "exactly_once_ok": True, "replicas_agree": True,
+        "stitched_traces": 183,
+    }
+    base.update(over)
+    return base
+
+
+def test_ledger_schema_locks_every_required_field():
+    assert benchguard.ledger_schema_violations(_ledger()) == []
+    for field in benchguard.LEDGER_REQUIRED:
+        broken = _ledger()
+        del broken[field]
+        assert benchguard.ledger_schema_violations(broken), field
+
+
+def test_ledger_schema_rejects_wrong_shapes():
+    bad = _ledger(exactly_once_ok="yes", chaos_windows="none",
+                  committed_tx_per_sec="fast")
+    problems = benchguard.ledger_schema_violations(bad)
+    assert len(problems) == 3
+
+
+def test_ledger_regression_fails_against_trajectory(tmp_path):
+    good = tmp_path / "LEDGER_r01.json"
+    good.write_text(json.dumps(_ledger(committed_tx_per_sec=10.0)))
+    # throughput collapse breaches the floor
+    slow = _ledger(committed_tx_per_sec=10.0 * (1 - 0.16))
+    problems = benchguard.guard_ledger(slow, [str(good)])
+    assert any("committed_tx_per_sec" in p for p in problems)
+    # uniqueness-tail blowup breaches the ceiling
+    tail = _ledger(notary_uniqueness_p99_ms=100.0 * 1.6)
+    problems = benchguard.guard_ledger(tail, [str(good)])
+    assert any("notary_uniqueness_p99_ms" in p for p in problems)
+    # within tolerance passes
+    assert benchguard.guard_ledger(
+        _ledger(committed_tx_per_sec=9.0), [str(good)]) == []
+
+
+def test_ledger_smoke_gets_schema_check_only(tmp_path):
+    fast = tmp_path / "LEDGER_r01.json"
+    fast.write_text(json.dumps(_ledger(committed_tx_per_sec=1000.0)))
+    smoke = _ledger(committed_tx_per_sec=0.5, smoke=True)
+    assert benchguard.guard_ledger(smoke, [str(fast)]) == []
+
+
+def test_ledger_real_artifact_passes_self_replay():
+    paths = benchguard.ledger_trajectory_paths()
+    if not paths:
+        pytest.skip("no LEDGER_r*.json artifacts in this checkout")
+    with open(sorted(paths)[-1], encoding="utf-8") as f:
+        latest = json.load(f)
+    assert benchguard.guard_ledger(latest, paths) == []

@@ -1,0 +1,68 @@
+"""benchtrend: trajectory tables over the *_r0N.json artifacts."""
+import json
+
+from corda_tpu.tools.benchtrend import (FAMILIES, load_rounds, render_table,
+                                        trend_rows)
+
+
+def test_trend_rows_delta_tracks_headline_metric():
+    rounds = [
+        ("r01", {"value": 100.0, "vs_baseline": 1.0}),
+        ("r02", {"value": 150.0, "vs_baseline": 1.5}),
+        ("r03", {"value": 120.0, "vs_baseline": 1.2}),
+    ]
+    rows = trend_rows(rounds, ("value", "vs_baseline"))
+    assert rows[0]["delta_pct"] is None
+    assert round(rows[1]["delta_pct"]) == 50
+    assert round(rows[2]["delta_pct"]) == -20
+
+
+def test_trend_rows_skips_missing_headline_for_delta():
+    rounds = [
+        ("r01", {"value": 100.0}),
+        ("r02", {}),                       # skipped round: no headline
+        ("r03", {"value": 110.0}),
+    ]
+    rows = trend_rows(rounds, ("value",))
+    assert rows[1]["delta_pct"] is None
+    assert round(rows[2]["delta_pct"]) == 10  # vs r01, not the gap
+
+
+def test_render_table_formats_bools_and_missing():
+    rounds = [("r01", {"committed_tx_per_sec": 10.16,
+                       "exactly_once_ok": True, "smoke": True})]
+    out = render_table("ledger", rounds,
+                       ("committed_tx_per_sec", "exactly_once_ok",
+                        "nonexistent"))
+    assert "r01 (smoke)" in out
+    assert "10.16" in out and "yes" in out
+    line = [l for l in out.splitlines() if l.startswith("r01")][0]
+    assert line.rstrip().endswith("-")     # missing metric renders as -
+
+
+def test_render_table_empty():
+    assert "(no artifacts)" in render_table("bench", [], ("value",))
+
+
+def test_load_rounds_orders_and_unwraps(tmp_path):
+    # BENCH artifacts wrap the metrics in "parsed"; LEDGER ones are flat
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"rc": 0, "parsed": {"value": 2.0}}))
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"rc": 0, "parsed": {"value": 1.0}}))
+    (tmp_path / "BENCH_r03.json").write_text("not json {")
+    rounds = load_rounds("bench", root=str(tmp_path))
+    assert [r[0] for r in rounds] == ["r01", "r02"]   # corrupt one skipped
+    assert rounds[0][1] == {"value": 1.0}
+
+
+def test_every_family_has_glob_and_headline():
+    for fam, (glob_fn, metrics) in FAMILIES.items():
+        assert callable(glob_fn) and metrics, fam
+
+
+def test_cli_runs_over_real_repo_artifacts(capsys):
+    from corda_tpu.tools.benchtrend import main
+    assert main(["--family", "ledger"]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith("ledger")

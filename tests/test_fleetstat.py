@@ -50,3 +50,43 @@ def test_render_flags_stale_and_degraded():
 def test_render_survives_empty_payloads():
     screen = render({}, {})
     assert "no workers attached" in screen
+
+
+def test_render_survives_non_dict_payloads():
+    # a webserver mid-restart can serve error strings / partial bodies
+    for fleet, metrics in (
+            (None, None), ("oops", []), ([], "oops"), (42, {"x": 1})):
+        screen = render(fleet, metrics)
+        assert "no workers attached" in screen
+
+
+def test_render_survives_malformed_worker_entries():
+    fleet = {
+        "expected": None, "attached": "soon", "stale": "not-a-list",
+        "workers": {
+            "w0": None,                       # crashed mid-report
+            "w1": "garbage",
+            "w2": {"queue_depth": None, "capacity": {"nested": 1},
+                   "last_report_age_s": "n/a"},
+            3: {"queue_depth": 1},            # non-string worker key
+        },
+    }
+    screen = render(fleet, {"SigBatcher.Checked": "not-a-dict"})
+    lines = screen.splitlines()
+    # every worker still gets a row, defaults filled in
+    for name in ("w0", "w1", "w2", "3"):
+        assert any(l.startswith(name) for l in lines), name
+    w2 = next(l for l in lines if l.startswith("w2"))
+    assert "n/a" in w2            # string age passes through
+
+
+def test_render_missing_metric_family_zeroes_columns():
+    metrics = {  # only one family present for w0; none for w1
+        'SigBatcher.Checked{worker="w0"}': {"type": "meter", "count": 7},
+        'SigBatcher.DeviceChecked{worker="w0"}': "corrupt",
+    }
+    screen = render(FLEET, metrics)
+    w0 = next(l for l in screen.splitlines() if l.startswith("w0"))
+    w1 = next(l for l in screen.splitlines() if l.startswith("w1"))
+    assert "7" in w0
+    assert w1.split()[-4:] == ["0", "0", "0", "0"]

@@ -1,0 +1,86 @@
+"""RequestLog bounded retention: terminal-aware eviction under churn.
+
+The debug surface exists to answer "why did request N land on worker
+W?" — so a long-lived in-flight request must keep its full timeline
+while short-lived resolved requests churn through the ring around it.
+"""
+import logging
+
+from corda_tpu.observability.lifecycle import RequestLog, TERMINAL_EVENTS
+
+logging.getLogger("corda_tpu.observability.lifecycle").setLevel(
+    logging.CRITICAL)
+
+
+def _run(log, vid):
+    log.append(vid, "submitted")
+    log.append(vid, "routed", worker="w0", reason="least-loaded")
+    log.append(vid, "resolved", ok=True)
+
+
+def test_capacity_bound_and_whole_timeline_eviction():
+    log = RequestLog(capacity=3)
+    for vid in range(5):
+        _run(log, vid)
+    snap = log.snapshot()
+    assert len(snap) == 3
+    assert log.dropped == 2
+    # evicted whole: the survivors carry their complete event trail
+    for tl in snap.values():
+        assert [e["event"] for e in tl] == ["submitted", "routed", "resolved"]
+
+
+def test_resolved_timelines_evicted_before_in_flight():
+    log = RequestLog(capacity=3)
+    log.append(100, "submitted")            # long-lived, never resolves
+    _run(log, 101)                          # resolved
+    _run(log, 102)                          # resolved
+    _run(log, 103)                          # forces one eviction
+    snap = log.snapshot()
+    # 101 (oldest RESOLVED) went, not 100 (oldest overall, in flight)
+    assert "100" in snap and "101" not in snap
+    assert "102" in snap and "103" in snap
+    assert log.dropped == 1
+
+
+def test_in_flight_survives_heavy_churn_with_full_history():
+    cap = 8
+    log = RequestLog(capacity=cap)
+    pinned = [1000, 1001, 1002]
+    for vid in pinned:
+        log.append(vid, "submitted")
+    for i in range(200):                    # 200 short-lived requests
+        _run(log, i)
+        if i % 50 == 0:                     # pinned requests stay active
+            for vid in pinned:
+                log.append(vid, "dispatched", worker=f"w{i % 3}", batch=i)
+    for vid in pinned:
+        log.append(vid, "resolved", ok=True)
+    snap = log.snapshot()
+    assert len(snap) <= cap
+    for vid in pinned:
+        events = [e["event"] for e in snap[str(vid)]]
+        # one unbroken timeline: submitted + 4 dispatches + resolved
+        assert events[0] == "submitted" and events[-1] == "resolved"
+        assert events.count("dispatched") == 4
+        assert log.terminal_count(vid) == 1
+    # everything evicted was a whole resolved timeline
+    assert log.dropped == 200 + len(pinned) - cap
+
+
+def test_fifo_fallback_when_nothing_resolved():
+    log = RequestLog(capacity=2)
+    log.append(1, "submitted")
+    log.append(2, "submitted")
+    log.append(3, "submitted")              # all in flight: oldest goes
+    snap = log.snapshot()
+    assert sorted(snap) == ["2", "3"]
+    assert log.dropped == 1
+
+
+def test_terminal_count_tracks_terminal_events():
+    log = RequestLog(capacity=4)
+    _run(log, 7)
+    assert log.terminal_count(7) == 1
+    assert TERMINAL_EVENTS  # the invariant the chaos suites key off
+    assert log.terminal_count(999) == 0

@@ -1,0 +1,104 @@
+"""SLOTracker: error budgets, multi-window burn rates, and alerts.
+
+Deterministic — the tracker's clock is injected, so the sliding windows
+are stepped by hand.
+"""
+import pytest
+
+from corda_tpu.observability.slo import (DEFAULT_OBJECTIVES, SLObjective,
+                                         SLOTracker)
+from corda_tpu.utils.metrics import MetricRegistry
+
+
+class Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def make(objectives=None, **kw):
+    clock = Clock()
+    kw.setdefault("windows_s", (10.0, 100.0))
+    tracker = SLOTracker(objectives=objectives or DEFAULT_OBJECTIVES,
+                         clock=clock, **kw)
+    return tracker, clock
+
+
+def test_untouched_budget_is_100():
+    tracker, _ = make()
+    for obj in tracker.objectives:
+        assert tracker.error_budget_pct(obj) == 100.0
+    assert tracker.alerts() == []
+    assert tracker.status()["alerting"] is False
+
+
+def test_availability_budget_burns_with_failures():
+    avail = SLObjective("availability", 0.9)     # 10% budget
+    tracker, clock = make(objectives=(avail,))
+    for i in range(100):
+        tracker.record(ok=(i % 10 != 0), latency_s=0.01)  # 10% failures
+    # burning exactly at budget: burn rate 1.0, budget fully consumed
+    assert tracker.burn_rates(avail)[100.0] == pytest.approx(1.0)
+    assert tracker.error_budget_pct(avail) == pytest.approx(0.0)
+
+
+def test_latency_objective_counts_slow_commits_as_bad():
+    lat = SLObjective("latency_p99", 0.99, latency_ms=100.0)
+    tracker, _ = make(objectives=(lat,))
+    tracker.record(ok=True, latency_s=0.05)      # under the bound
+    tracker.record(ok=True, latency_s=0.5)       # slow == bad
+    tracker.record(ok=False, latency_s=None)     # failed == bad
+    assert lat.is_bad(True, 0.5) and lat.is_bad(False, None)
+    assert not lat.is_bad(True, 0.05)
+    assert tracker.error_budget_pct(lat) < 100.0
+
+
+def test_events_age_out_of_the_window():
+    avail = SLObjective("availability", 0.9)
+    tracker, clock = make(objectives=(avail,))
+    tracker.record(ok=False)
+    assert tracker.error_budget_pct(avail) < 100.0
+    clock.t += 101.0                             # past the long window
+    tracker.record(ok=True)
+    assert tracker.error_budget_pct(avail) == 100.0
+
+
+def test_page_needs_both_windows_burning():
+    avail = SLObjective("availability", 0.999)   # tiny budget: easy burn
+    tracker, clock = make(objectives=(avail,))
+    # old bad events: long window burns, short window is clean
+    for _ in range(20):
+        tracker.record(ok=False)
+    clock.t += 50.0
+    for _ in range(20):
+        tracker.record(ok=True, latency_s=0.001)
+    alerts = tracker.alerts()
+    assert [a["severity"] for a in alerts] == ["ticket"]
+    # now the short window burns too → page
+    for _ in range(20):
+        tracker.record(ok=False)
+    alerts = tracker.alerts()
+    assert alerts and alerts[0]["severity"] == "page"
+    assert tracker.status()["alerting"] is True
+
+
+def test_publish_exports_gauges():
+    tracker, _ = make()
+    registry = MetricRegistry()
+    tracker.publish(registry)
+    tracker.record(ok=False)
+    snap = registry.snapshot()
+    assert "SLO.availability.ErrorBudgetPct" in snap
+    assert "SLO.Alerting" in snap
+    names = {n for n in snap if n.startswith("SLO.")}
+    assert any("BurnRateShort" in n for n in names)
+    assert any("BurnRateLong" in n for n in names)
+
+
+def test_window_validation():
+    with pytest.raises(ValueError):
+        SLOTracker(windows_s=(60.0,))
+    with pytest.raises(ValueError):
+        SLOTracker(windows_s=(300.0, 60.0))
