@@ -486,6 +486,10 @@ def ledger_main() -> None:
                         "on every replica")
     if not out["replicas_agree"]:
         problems.append("raft replicas diverged at quiescence")
+    if not out["counter_invariant_ok"]:
+        problems.append("commit counters do not reconcile: committed != "
+                        "notarised + self-issue (a committed tx either "
+                        "passed the notary or had no inputs to check)")
     if out["stitched_traces"] < 1:
         problems.append("no connected flow.run→vault.update trace "
                         "(commit-path span stitching broken)")

@@ -15,7 +15,43 @@ from ..utils import retry
 
 
 class _LeaderUnknown(RuntimeError):
-    """Transient leaderless window — retried by consensus_commit."""
+    """Transient leaderless window — retried by consensus_round."""
+
+
+def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
+                    on_attempt=None):
+    """One blocking replicated-state-machine round: submit ``command`` to
+    `backend` (RaftNode or BFTClient), retrying leaderless windows with
+    decorrelated-jitter backoff inside the timeout budget, abandoning the
+    pending entry on timeout so the request table cannot leak. Returns the
+    apply result verbatim — callers interpret verdicts. ``on_attempt`` (if
+    given) is called once per actual submit, the seam the GroupCommitter
+    uses to count real raft appends."""
+
+    def _submit(ctx):
+        kwargs = {}
+        if getattr(backend, "supports_trace_ctx", False):
+            kwargs["trace_ctx"] = ctx
+        if on_attempt is not None:
+            on_attempt()
+        fut = backend.submit(command, **kwargs)
+        try:
+            return fut.result(timeout=timeout_s)
+        except concurrent.futures.TimeoutError:
+            backend.abandon(fut)
+            raise
+        except RuntimeError as e:
+            # only the leadership errors are retryable; anything else
+            # (a replica bug, a closed backend) propagates immediately
+            if "leader" in str(e):
+                raise _LeaderUnknown(str(e)) from e
+            raise
+
+    return retry.retry_call(
+        lambda: _submit(trace_ctx), site="raft.submit",
+        policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5, max_attempts=6,
+                                 deadline_s=timeout_s),
+        retry_on=(_LeaderUnknown,))
 
 
 def consensus_commit(backend, states, tx_id, caller: str,
@@ -37,35 +73,14 @@ def consensus_commit(backend, states, tx_id, caller: str,
     commit-path stage histogram."""
     from ..observability import get_tracer
 
-    def _submit(ctx):
-        kwargs = {}
-        if getattr(backend, "supports_trace_ctx", False):
-            kwargs["trace_ctx"] = ctx
-        fut = backend.submit(("put_all", [tx_id, list(states), caller]),
-                             **kwargs)
-        try:
-            return fut.result(timeout=timeout_s)
-        except concurrent.futures.TimeoutError:
-            backend.abandon(fut)
-            raise
-        except RuntimeError as e:
-            # only the leadership errors are retryable; anything else
-            # (a replica bug, a closed backend) propagates immediately
-            if "leader" in str(e):
-                raise _LeaderUnknown(str(e)) from e
-            raise
-
     with get_tracer().span("raft.commit", parent=trace_ctx,
                            n_states=len(states), caller=caller) as sp:
         ctx = sp.context() or trace_ctx
         t0 = _time.perf_counter()
         try:
-            result = retry.retry_call(
-                lambda: _submit(ctx), site="raft.submit",
-                policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5,
-                                         max_attempts=6,
-                                         deadline_s=timeout_s),
-                retry_on=(_LeaderUnknown,))
+            result = consensus_round(
+                backend, ("put_all", [tx_id, list(states), caller]),
+                timeout_s, trace_ctx=ctx)
         finally:
             if metrics is not None:
                 trace_id = getattr(ctx, "trace_id", None)

@@ -22,14 +22,31 @@ class DistributedImmutableMap:
     def apply(self, command) -> dict:
         from ..node.notary import find_conflicts, record_all
         kind, payload = command
-        if kind != "put_all":
-            raise ValueError(f"unknown command {kind!r}")
-        tx_id, refs, caller = payload
-        conflicts = find_conflicts(self._map, refs, tx_id)
-        if conflicts:
-            return {"committed": False, "conflicts": conflicts}
-        record_all(self._map, refs, tx_id, caller)
-        return {"committed": True, "conflicts": {}}
+        if kind == "put_all":
+            tx_id, refs, caller = payload
+            conflicts = find_conflicts(self._map, refs, tx_id)
+            if conflicts:
+                return {"committed": False, "conflicts": conflicts}
+            record_all(self._map, refs, tx_id, caller)
+            return {"committed": True, "conflicts": {}}
+        if kind == "put_all_batch":
+            # Group commit (commit_pipeline.GroupCommitter): one log entry
+            # carries many transactions, applied IN LIST ORDER with a
+            # per-tx verdict — a conflicting tx is rejected individually
+            # without poisoning the rest of its batch, and the first
+            # spender of a ref within the batch wins deterministically on
+            # every replica (apply order == list order == log order).
+            results = []
+            for tx_id, refs, caller in payload:
+                conflicts = find_conflicts(self._map, refs, tx_id)
+                if conflicts:
+                    results.append({"committed": False,
+                                    "conflicts": conflicts})
+                else:
+                    record_all(self._map, refs, tx_id, caller)
+                    results.append({"committed": True, "conflicts": {}})
+            return {"batch": True, "results": results}
+        raise ValueError(f"unknown command {kind!r}")
 
     def __len__(self):
         return len(self._map)
@@ -51,6 +68,7 @@ class RaftUniquenessProvider(UniquenessProvider):
     def __init__(self, raft_node: RaftNode, timeout_s: float = 30.0):
         self.raft = raft_node
         self.timeout_s = timeout_s
+        self._committer = None   # lazy GroupCommitter (commit_async path)
 
     @staticmethod
     def build(node_id: str, peers: list[str], messaging,
@@ -98,3 +116,31 @@ class RaftUniquenessProvider(UniquenessProvider):
         from .provider import consensus_commit
         consensus_commit(self.raft, states, tx_id, caller, self.timeout_s,
                          trace_ctx=trace_ctx, metrics=metrics)
+
+    def commit_async(self, states, tx_id, caller: str, trace_ctx=None,
+                     metrics=None):
+        """Group-commit path: enqueue on the shared GroupCommitter and
+        return a Future that resolves None on commit or fails with
+        UniquenessException on conflict. Requests from many concurrently
+        suspended flows coalesce into one ``put_all_batch`` raft append
+        per flush — one consensus round amortized over the whole batch
+        (commit_pipeline.GroupCommitter)."""
+        committer = self._committer
+        if committer is None:
+            from .commit_pipeline import GroupCommitter
+            sm = getattr(self, "state_machine", None)
+            committer = GroupCommitter(
+                self.raft, timeout_s=self.timeout_s, metrics=metrics,
+                applied_view=(lambda: sm._map) if sm is not None else None)
+            self._committer = committer
+        return committer.submit(states, tx_id, caller, trace_ctx=trace_ctx)
+
+    @property
+    def group_committer(self):
+        return self._committer
+
+    def close(self) -> None:
+        """Stop the group committer's flush machinery (tests/harness)."""
+        if self._committer is not None:
+            self._committer.close()
+            self._committer = None

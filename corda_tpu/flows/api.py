@@ -100,6 +100,37 @@ class Verify:
 
 
 @dataclass(frozen=True)
+class VerifyMany:
+    """Suspend until the verifier service resolves ALL of ``stxs`` — one
+    yield site submits the whole wave, so N transactions' signatures land
+    in the batcher concurrently instead of one service round-trip per
+    link (the wave-based ResolveTransactionsFlow discipline). Resumes with
+    None when every verification succeeds; the FIRST failure (submission
+    order) is thrown at the yield site with its original type."""
+
+    stxs: tuple
+    check_sufficient_signatures: bool = True
+
+
+@dataclass(frozen=True)
+class AwaitFuture:
+    """Suspend until the Future returned by ``producer()`` resolves — the
+    generic park-on-a-future primitive (the reference parks fibers on
+    ListenableFutures). ``producer`` runs on the node thread at the yield
+    site; it must return a concurrent.futures.Future (or None, which
+    resumes immediately with None). The flow resumes with the future's
+    (checkpoint-serializable) result, or the future's exception is thrown
+    at the yield site with its original type preserved across replay.
+
+    On checkpoint replay the producer is RE-EXECUTED, so it must be
+    idempotent — the group-commit path qualifies: re-submitting a
+    committed transaction's refs is absorbed by find_conflicts' same-tx
+    rule."""
+
+    producer: Callable[[], Any]
+
+
+@dataclass(frozen=True)
 class ExecuteOnce:
     """Run a local, possibly non-deterministic computation exactly once and
     checkpoint its (serializable) result: on replay the recorded value is

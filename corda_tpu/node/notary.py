@@ -201,6 +201,66 @@ class NotaryService:
                             "notary_uniqueness_seconds").update(
                                 _time.perf_counter() - t0, trace_id=trace_id)
 
+    @property
+    def supports_async_commit(self) -> bool:
+        """True when the uniqueness backend can group-commit (the raft
+        provider's commit_async path) — NotaryServiceFlow parks on the
+        returned future instead of blocking the notary node thread for a
+        full consensus round per transaction."""
+        return hasattr(self.uniqueness, "commit_async")
+
+    def commit_async(self, input_refs, tx_id, caller_name: str,
+                     trace_ctx=None):
+        """Group-commit path: enqueue on the provider's GroupCommitter and
+        return a Future resolving None on commit / failing with
+        UniquenessException on conflict. The ``notary.commit`` and
+        ``notary.uniqueness`` spans are opened here and finished when the
+        verdict lands, so span durations cover the true suspended wait and
+        /traces stitching matches the sync path's shape. Returns None when
+        the backend has no async path (caller falls back to sync commit)."""
+        import time as _time
+        from concurrent.futures import Future
+
+        from ..observability import get_tracer, jlog
+        if not self.supports_async_commit:
+            return None
+        refs = list(input_refs)
+        jlog(_log, "notary.commit", ctx=trace_ctx,
+             tx_id=tx_id.bytes.hex()[:16], n_inputs=len(refs),
+             caller=caller_name, group_commit=True)
+        tracer = get_tracer()
+        sp = tracer.span("notary.commit", parent=trace_ctx,
+                         tx_id=tx_id.bytes.hex()[:16], n_inputs=len(refs),
+                         caller=caller_name, group_commit=True)
+        uctx = sp.context() or trace_ctx
+        usp = tracer.span("notary.uniqueness", parent=uctx,
+                          tx_id=tx_id.bytes.hex()[:16], n_inputs=len(refs))
+        t0 = _time.perf_counter()
+        inner = self.uniqueness.commit_async(
+            refs, tx_id, caller_name, trace_ctx=usp.context() or uctx,
+            metrics=getattr(self.hub, "monitoring", None))
+        outer: Future = Future()
+
+        def _done(f):
+            err = f.exception()
+            monitoring = getattr(self.hub, "monitoring", None)
+            if monitoring is not None:
+                trace_id = getattr(uctx, "trace_id", None)
+                monitoring.histogram("notary_uniqueness_seconds").update(
+                    _time.perf_counter() - t0, trace_id=trace_id)
+            if err is not None:
+                usp.set_tag("error", f"{type(err).__name__}: {err}")
+                sp.set_tag("error", f"{type(err).__name__}: {err}")
+            usp.finish()
+            sp.finish()
+            if err is None:
+                outer.set_result(None)
+            else:
+                outer.set_exception(err)
+
+        inner.add_done_callback(_done)
+        return outer
+
     def sign_tx_id(self, tx_id):
         return self.hub.sign(tx_id.bytes)
 

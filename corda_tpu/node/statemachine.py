@@ -24,10 +24,10 @@ from dataclasses import dataclass
 from typing import Any
 
 from ..core.serialization import deserialize, register_type, serialize
-from ..flows.api import (ExecuteOnce, FlowException, FlowLogic, FlowSession,
-                         FlowTimeoutException, Receive, Send, SendAndReceive,
-                         Sleep, UntrustworthyData, Verify,
-                         WaitForLedgerCommit, flow_name,
+from ..flows.api import (AwaitFuture, ExecuteOnce, FlowException, FlowLogic,
+                         FlowSession, FlowTimeoutException, Receive, Send,
+                         SendAndReceive, Sleep, UntrustworthyData, Verify,
+                         VerifyMany, WaitForLedgerCommit, flow_name,
                          get_initiated_flow_factory)
 from ..network.messaging import TOPIC_P2P, TopicSession
 from ..observability import get_tracer, jlog
@@ -382,6 +382,10 @@ class StateMachineManager:
             return self._log(fsm, ("value", request.producer()))
         if isinstance(request, Verify):
             return self._do_verify(fsm, request)
+        if isinstance(request, VerifyMany):
+            return self._do_verify_many(fsm, request)
+        if isinstance(request, AwaitFuture):
+            return self._do_await_future(fsm, request)
         if isinstance(request, Sleep):
             return _PARK        # woken only by its timer (see _arm_timer)
         raise TypeError(f"Flow yielded a non-request value: {request!r}")
@@ -498,6 +502,103 @@ class StateMachineManager:
         else:
             # the log records the type too, so a flow that CAUGHT this
             # error and continued replays identically after a restart
+            fsm.response_log.append(("error", _error_payload(err)))
+            self._resume(fsm, error=err)
+
+    def _do_verify_many(self, fsm: FlowStateMachine, request: VerifyMany):
+        """One yield site, N verifier submissions: the whole wave of a
+        dependency-resolution frontier lands in the batcher concurrently
+        (the group-commit analog on the verify side). Resumes with None
+        when every verification succeeds; the first failure in submission
+        order is thrown at the yield site. A node without an async
+        verifier service falls back to verifying the wave synchronously."""
+        stxs = list(request.stxs)
+        if not stxs:
+            return self._log(fsm, ("value", None))
+        svc = self.hub.verifier_service
+        if svc is None or not hasattr(svc, "verify_signed"):
+            for stx in stxs:
+                try:
+                    stx.verify(self.hub, check_sufficient_signatures=
+                               request.check_sufficient_signatures)
+                except Exception as e:
+                    return self._log(fsm, ("error", _error_payload(e)))
+            return self._log(fsm, ("value", None))
+        kwargs = {}
+        if getattr(svc, "supports_trace_ctx", False) and fsm.trace_ctx is not None:
+            kwargs["trace_ctx"] = fsm.trace_ctx
+        futs = [svc.verify_signed(
+                    stx, self.hub, check_sufficient_signatures=
+                    request.check_sufficient_signatures, **kwargs)
+                for stx in stxs]
+        # ONE external-wait slot for the whole wave: the flow resumes once,
+        # when the slowest member resolves
+        self._awaiting_external += 1
+        state = {"remaining": len(futs), "errors": {}}
+        for i, fut in enumerate(futs):
+            fut.add_done_callback(
+                lambda f, i=i: self._post_external(
+                    lambda: self._on_verify_many_one(fsm, f, i, state,
+                                                     request)))
+        return _PARK
+
+    def _on_verify_many_one(self, fsm: FlowStateMachine, fut: Future,
+                            index: int, state: dict,
+                            request: VerifyMany) -> None:
+        """Node-thread continuation for ONE member of a VerifyMany wave;
+        the last arrival resumes the flow."""
+        err = fut.exception()
+        if err is not None:
+            state["errors"][index] = err
+        state["remaining"] -= 1
+        if state["remaining"] > 0:
+            return
+        self._awaiting_external -= 1
+        if fsm.done or fsm.run_id not in self.flows:
+            return
+        if fsm.parked_on is not request:
+            return
+        if state["errors"]:
+            first = state["errors"][min(state["errors"])]
+            fsm.response_log.append(("error", _error_payload(first)))
+            self._resume(fsm, error=first)
+        else:
+            fsm.response_log.append(("value", None))
+            self._resume(fsm, value=None)
+
+    def _do_await_future(self, fsm: FlowStateMachine, request: AwaitFuture):
+        """Generic park-on-a-future (the notary-wait suspension point for
+        the group-commit path): the producer runs on the node thread and
+        returns a Future; the flow parks until it resolves and resumes
+        with its result (which must be checkpoint-serializable) or its
+        exception, type preserved across replay."""
+        fut = request.producer()
+        if fut is None:
+            return self._log(fsm, ("value", None))
+        if fut.done():   # fast path — no external wait, no extra drain turn
+            err = fut.exception()
+            if err is None:
+                return self._log(fsm, ("value", fut.result()))
+            return self._log(fsm, ("error", _error_payload(err)))
+        self._awaiting_external += 1
+        fut.add_done_callback(
+            lambda f: self._post_external(
+                lambda: self._on_await_done(fsm, f, request)))
+        return _PARK
+
+    def _on_await_done(self, fsm: FlowStateMachine, fut: Future,
+                       request: AwaitFuture) -> None:
+        """Node-thread continuation of an AwaitFuture park."""
+        self._awaiting_external -= 1
+        if fsm.done or fsm.run_id not in self.flows:
+            return
+        if fsm.parked_on is not request:
+            return
+        err = fut.exception()
+        if err is None:
+            fsm.response_log.append(("value", fut.result()))
+            self._resume(fsm, value=fut.result())
+        else:
             fsm.response_log.append(("error", _error_payload(err)))
             self._resume(fsm, error=err)
 
@@ -852,6 +953,75 @@ class StateMachineManager:
         self._notify("add", fsm)
         self._start_generator(fsm)
         self._advance(fsm, first=True)
+
+
+class FlowScheduler:
+    """Bounded-concurrency flow launcher for one node — the cooperative
+    multi-flow discipline (reference: thousands of Quasar fibers per node,
+    PAPER.md L5b). Flows already interleave on the node thread by parking
+    at send/receive/verify/notary-wait; what serialized them was the
+    caller launching one flow and joining it end-to-end. The scheduler
+    keeps up to ``max_concurrent`` flows in flight so a node continuously
+    feeds the verifier batcher's and the GroupCommitter's bulk classes.
+
+    Node-thread only: ``submit`` enqueues a factory and returns a proxy
+    Future; each completion launches the next waiter via the external
+    queue (never recursively inside the finishing flow's stack), so
+    MockNetwork pumping and checkpoint replay stay deterministic."""
+
+    def __init__(self, smm: StateMachineManager, max_concurrent: int = 8):
+        self.smm = smm
+        self.max_concurrent = max_concurrent
+        self._waiting: list = []      # (flow factory, proxy future)
+        self._in_flight = 0
+        self.high_water = 0           # max concurrent in-flight observed
+        self.launched = 0
+
+    @property
+    def in_flight(self) -> int:
+        return self._in_flight
+
+    @property
+    def waiting(self) -> int:
+        return len(self._waiting)
+
+    def submit(self, flow_factory) -> Future:
+        """Queue a flow for launch; returns a Future mirroring the flow's
+        result_future (result or exception)."""
+        proxy: Future = Future()
+        self._waiting.append((flow_factory, proxy))
+        self._pump()
+        return proxy
+
+    def _pump(self) -> None:
+        while self._waiting and self._in_flight < self.max_concurrent:
+            factory, proxy = self._waiting.pop(0)
+            self._in_flight += 1
+            self.launched += 1
+            if self._in_flight > self.high_water:
+                self.high_water = self._in_flight
+            try:
+                fsm = self.smm.add(factory())
+            except Exception as e:
+                self._in_flight -= 1
+                proxy.set_exception(e)
+                continue
+            fsm.result_future.add_done_callback(
+                lambda f, proxy=proxy: self._on_done(f, proxy))
+
+    def _on_done(self, fut: Future, proxy: Future) -> None:
+        # result_future resolves on the node thread (_complete/_fail), so
+        # launching the next waiter here would recursively advance a new
+        # flow inside the finishing flow's stack — defer the pump through
+        # the external queue to keep the drive loop's discipline
+        self._in_flight -= 1
+        err = fut.exception()
+        if err is None:
+            proxy.set_result(fut.result())
+        else:
+            proxy.set_exception(err)
+        if self._waiting:
+            self.smm._post_external(self._pump)
 
 
 _PARK = object()

@@ -62,6 +62,32 @@ def stage_percentiles(snapshot: dict) -> dict:
     return out
 
 
+def group_commit_fields(snapshot: dict) -> dict:
+    """Flatten the GroupCommitter's amortization metrics into LEDGER
+    artifact fields. Always present (0.0 defaults): a run without a
+    group-commit path must LOOK unbatched (occupancy 0), not crash the
+    schema — the before/after is the point of the fields."""
+    sizes = snapshot.get("ledger_commit_batch_size") or {}
+    appends = (snapshot.get("GroupCommit.RaftAppends") or {}).get("count", 0)
+    committed = (snapshot.get("GroupCommit.Committed") or {}).get("count", 0)
+    out = {
+        "commit_batch_occupancy_mean": round(sizes.get("mean", 0.0), 2),
+        "commit_batch_occupancy_p99": round(sizes.get("p99", 0.0), 1),
+        "ledger_commit_batch_count": int(sizes.get("count", 0)),
+        "group_commit_raft_appends": int(appends),
+        "group_commit_committed": int(committed),
+        "group_commit_rejected": int(
+            (snapshot.get("GroupCommit.Rejected") or {}).get("count", 0)),
+        "group_commit_prescreened": int(
+            (snapshot.get("GroupCommit.PreScreened") or {}).get("count", 0)),
+        "group_commit_deferred": int(
+            (snapshot.get("GroupCommit.Deferred") or {}).get("count", 0)),
+        "raft_appends_per_committed_tx":
+            round(appends / committed, 4) if committed else 0.0,
+    }
+    return out
+
+
 def ledger_stage_percentiles(snapshot: dict) -> dict:
     """Flatten the commit-path stage histograms into LEDGER artifact
     fields: ``ledger_stage_<stage>_ms_<q>``. Same omission rule as

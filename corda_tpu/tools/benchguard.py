@@ -314,6 +314,17 @@ def guard_multichip(current: dict,
 LEDGER_GUARDED: dict = {
     "committed_tx_per_sec": ("higher", RATE_TOLERANCE),
     "notary_uniqueness_p99_ms": ("lower", TAIL_TOLERANCE),
+    # group-commit locks (ISSUE 11): appends-per-tx is the amortization
+    # promise itself (1.0 = unbatched; a slide back toward 1 means the
+    # pipeline re-serialized) and occupancy is its positive mirror. Both
+    # only fit once a full run emits them (>0 filter skips older rounds).
+    "raft_appends_per_committed_tx": ("lower", TAIL_TOLERANCE),
+    "commit_batch_occupancy_mean": ("higher", RATE_TOLERANCE),
+    # per-flow-class tails: the scheduler must not buy throughput by
+    # starving one class (settle is the deepest flow — two legs + DvP)
+    "e2e_ms_p99_issue": ("lower", TAIL_TOLERANCE),
+    "e2e_ms_p99_pay": ("lower", TAIL_TOLERANCE),
+    "e2e_ms_p99_settle": ("lower", TAIL_TOLERANCE),
 }
 
 #: Fields every LEDGER artifact must carry (the --smoke --ledger schema
@@ -332,12 +343,32 @@ LEDGER_REQUIRED: tuple = (
     "notary_uniqueness_p99_ms", "slo_error_budget_pct",
     "chaos_enabled", "chaos_windows",
     "exactly_once_ok", "replicas_agree", "stitched_traces",
+    # group-commit pipeline (ISSUE 11): the amortization self-report — a
+    # wiring regression that silently drops the GroupCommitter (or its
+    # metrics) fails the smoke gate here, device or not
+    "committed_tx_count", "self_issue_tx_count", "notarised_input_tx_count",
+    "counter_invariant_ok", "node_concurrency",
+    "max_concurrent_flows_per_node", "flows_launched",
+    "commit_batch_occupancy_mean", "commit_batch_occupancy_p99",
+    "ledger_commit_batch_count", "group_commit_raft_appends",
+    "group_commit_committed", "group_commit_rejected",
+    "group_commit_prescreened", "group_commit_deferred",
+    "raft_appends_per_committed_tx",
+    # per-flow-class attribution (issue/pay/settle) — e2e from intended
+    # submit time (open-loop), flow from actual launch
+    "e2e_ms_p50_issue", "e2e_ms_p90_issue", "e2e_ms_p99_issue",
+    "e2e_ms_p50_pay", "e2e_ms_p90_pay", "e2e_ms_p99_pay",
+    "e2e_ms_p50_settle", "e2e_ms_p90_settle", "e2e_ms_p99_settle",
+    "flow_ms_p50_issue", "flow_ms_p90_issue", "flow_ms_p99_issue",
+    "flow_ms_p50_pay", "flow_ms_p90_pay", "flow_ms_p99_pay",
+    "flow_ms_p50_settle", "flow_ms_p90_settle", "flow_ms_p99_settle",
 )
 
 #: required fields that are NOT numbers (shape-checked individually)
 _LEDGER_FIELD_TYPES: dict = {
     "metric": str, "unit": str,
     "chaos_enabled": bool, "exactly_once_ok": bool, "replicas_agree": bool,
+    "counter_invariant_ok": bool,
     "chaos_windows": list,
 }
 

@@ -210,6 +210,29 @@ def _ledger(**over):
         "chaos_enabled": True, "chaos_windows": [],
         "exactly_once_ok": True, "replicas_agree": True,
         "stitched_traces": 183,
+        # group-commit pipeline fields (ISSUE 11)
+        "committed_tx_count": 810, "self_issue_tx_count": 144,
+        "notarised_input_tx_count": 522, "counter_invariant_ok": True,
+        "node_concurrency": 4, "max_concurrent_flows_per_node": 4,
+        "flows_launched": 810,
+        "commit_batch_occupancy_mean": 4.76,
+        "commit_batch_occupancy_p99": 22.0,
+        "ledger_commit_batch_count": 140, "group_commit_raft_appends": 140,
+        "group_commit_committed": 666, "group_commit_rejected": 0,
+        "group_commit_prescreened": 0, "group_commit_deferred": 0,
+        "raft_appends_per_committed_tx": 0.21,
+        "e2e_ms_p50_issue": 100.0, "e2e_ms_p90_issue": 200.0,
+        "e2e_ms_p99_issue": 300.0,
+        "e2e_ms_p50_pay": 400.0, "e2e_ms_p90_pay": 800.0,
+        "e2e_ms_p99_pay": 1200.0,
+        "e2e_ms_p50_settle": 500.0, "e2e_ms_p90_settle": 1000.0,
+        "e2e_ms_p99_settle": 1500.0,
+        "flow_ms_p50_issue": 50.0, "flow_ms_p90_issue": 90.0,
+        "flow_ms_p99_issue": 120.0,
+        "flow_ms_p50_pay": 200.0, "flow_ms_p90_pay": 400.0,
+        "flow_ms_p99_pay": 600.0,
+        "flow_ms_p50_settle": 250.0, "flow_ms_p90_settle": 500.0,
+        "flow_ms_p99_settle": 700.0,
     }
     base.update(over)
     return base
@@ -244,6 +267,27 @@ def test_ledger_regression_fails_against_trajectory(tmp_path):
     # within tolerance passes
     assert benchguard.guard_ledger(
         _ledger(committed_tx_per_sec=9.0), [str(good)]) == []
+
+
+def test_ledger_group_commit_guards(tmp_path):
+    """The amortization locks: appends-per-tx sliding back toward 1.0
+    (re-serialization) breaches its ceiling; an occupancy collapse
+    breaches its floor; a per-class p99 blowup names its class."""
+    good = tmp_path / "LEDGER_r01.json"
+    good.write_text(json.dumps(_ledger()))
+    problems = benchguard.guard_ledger(
+        _ledger(raft_appends_per_committed_tx=0.21 * 1.6), [str(good)])
+    assert any("raft_appends_per_committed_tx" in p for p in problems)
+    problems = benchguard.guard_ledger(
+        _ledger(commit_batch_occupancy_mean=4.76 * (1 - 0.16)), [str(good)])
+    assert any("commit_batch_occupancy_mean" in p for p in problems)
+    problems = benchguard.guard_ledger(
+        _ledger(e2e_ms_p99_settle=1500.0 * 1.6), [str(good)])
+    assert any("e2e_ms_p99_settle" in p for p in problems)
+    # within tolerance passes clean
+    assert benchguard.guard_ledger(
+        _ledger(raft_appends_per_committed_tx=0.25,
+                commit_batch_occupancy_mean=4.2), [str(good)]) == []
 
 
 def test_ledger_smoke_gets_schema_check_only(tmp_path):

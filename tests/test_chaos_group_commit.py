@@ -1,0 +1,228 @@
+"""Group-commit chaos tests: double-spend pairs through the batched
+uniqueness pipeline under the seeded fault injector.
+
+The GroupCommitter coalesces many suspended flows' uniqueness commits
+into one ``put_all_batch`` raft append; the property under test is that
+batching never weakens the notary's SAFETY:
+
+- a conflicting pair landing in the SAME batch resolves first-wins in
+  list order, deterministically on every replica;
+- a pair split across ADJACENT batches rejects the second against the
+  replicated map (prescreen off — the consensus-side verdict itself is
+  what's exercised);
+- a pair straddling a LEADER KILL mid-batch commits at most once, and
+  the survivors converge on the one winner.
+
+Unlike test_chaos_raft's synchronous pumping, the committer runs real
+threads (ticker + batch pool), so each scenario drives the cluster from
+a background pump thread — the same discipline as the ledger harness.
+"""
+import threading
+import time
+
+import pytest
+
+from corda_tpu.consensus.commit_pipeline import GroupCommitter
+from corda_tpu.consensus.raft import LEADER
+from corda_tpu.consensus.raft_uniqueness import (DistributedImmutableMap,
+                                                 RaftUniquenessProvider)
+from corda_tpu.core.contracts.structures import StateRef
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.network.inmemory import InMemoryMessagingNetwork
+from corda_tpu.node.notary import UniquenessException
+from corda_tpu.testing.faults import FaultRule, inject
+
+pytestmark = pytest.mark.chaos
+
+SEEDS = [7, 101, 9001]
+
+
+def partition_rules(name):
+    return (FaultRule("net.send", "drop", detail=f"{name}->*"),
+            FaultRule("net.send", "drop", detail=f"*->{name}"))
+
+
+class _Cluster:
+    """3-replica raft cluster pumped from a background thread (the
+    GroupCommitter blocks on futures, so synchronous pumping deadlocks)."""
+
+    def __init__(self, seed: int, n: int = 3):
+        self.bus = InMemoryMessagingNetwork()
+        self.names = [f"raft{i}" for i in range(n)]
+        self.maps = [DistributedImmutableMap() for _ in range(n)]
+        self.providers = [RaftUniquenessProvider.build(
+            name, list(self.names), self.bus.create_node(name),
+            state_machine=self.maps[i], seed=seed + i, native=False)
+            for i, name in enumerate(self.names)]
+        self.nodes = [p.raft for p in self.providers]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name="chaos-gc-pump")
+        self._thread.start()
+
+    def _pump(self):
+        while not self._stop.is_set():
+            for rn in self.nodes:
+                rn.tick()
+            for name in self.names:
+                while self.bus.pump_receive(name) is not None:
+                    pass
+            time.sleep(0.002)
+
+    def wait_leader(self, exclude=(), timeout=15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders = [n for n in self.nodes
+                       if n.role == LEADER and n not in exclude]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.01)
+        raise AssertionError("no leader elected")
+
+    def wait_converged(self, n_entries: int, timeout=10.0, exclude=()):
+        """Poll until every (non-excluded) replica applied `n_entries` and
+        all agree ref-for-ref."""
+        live = [m for i, m in enumerate(self.maps)
+                if self.nodes[i] not in exclude]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(len(m) == n_entries for m in live) \
+                    and all(m._map == live[0]._map for m in live):
+                return live
+            time.sleep(0.01)
+        raise AssertionError(
+            f"replicas did not converge on {n_entries} entries: "
+            f"{[len(m) for m in live]}")
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def _ref(tag: str) -> StateRef:
+    return StateRef(SecureHash.sha256(tag.encode()), 0)
+
+
+def _tx(tag: str):
+    return SecureHash.sha256(b"tx:" + tag.encode())
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_double_spend_pair_same_batch(seed):
+    """Two spends of one ref admitted into the SAME batch (prescreen off,
+    max_batch cuts at 2): apply resolves first-wins in list order — one
+    raft append, one winner, the loser rejected with the conflict, every
+    replica recording the same owner."""
+    cluster = _Cluster(seed)
+    committer = None
+    try:
+        leader = cluster.wait_leader()
+        committer = GroupCommitter(leader, timeout_s=10.0, max_batch=2,
+                                   max_latency_s=0.5, prescreen=False)
+        ref = _ref(f"same-batch-{seed}")
+        f_win = committer.submit([ref], _tx("winner"), "chaos")
+        f_lose = committer.submit([ref], _tx("loser"), "chaos")
+
+        assert f_win.result(timeout=15) is None
+        with pytest.raises(UniquenessException) as ei:
+            f_lose.result(timeout=15)
+        assert ref in ei.value.conflicts
+        assert ei.value.conflicts[ref].consuming_tx == _tx("winner")
+
+        maps = cluster.wait_converged(1)
+        assert maps[0]._map[ref].consuming_tx == _tx("winner")
+        snap = committer.metrics.snapshot()
+        # the whole pair rode ONE consensus round
+        assert snap["GroupCommit.RaftAppends"]["count"] == 1
+        assert snap["GroupCommit.Committed"]["count"] == 1
+        assert snap["GroupCommit.Rejected"]["count"] == 1
+    finally:
+        if committer is not None:
+            committer.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_double_spend_pair_adjacent_batches(seed):
+    """The pair split across ADJACENT batches (max_batch=1 — every submit
+    is its own append): the second spend must be rejected by the
+    replicated apply, not by any leader-local shortcut (prescreen off)."""
+    cluster = _Cluster(seed)
+    committer = None
+    try:
+        leader = cluster.wait_leader()
+        committer = GroupCommitter(leader, timeout_s=10.0, max_batch=1,
+                                   max_latency_s=0.005, prescreen=False)
+        ref = _ref(f"adjacent-{seed}")
+        assert committer.submit([ref], _tx("first"), "chaos") \
+            .result(timeout=15) is None
+        with pytest.raises(UniquenessException) as ei:
+            committer.submit([ref], _tx("second"), "chaos").result(timeout=15)
+        assert ei.value.conflicts[ref].consuming_tx == _tx("first")
+
+        maps = cluster.wait_converged(1)
+        assert maps[0]._map[ref].consuming_tx == _tx("first")
+        snap = committer.metrics.snapshot()
+        assert snap["GroupCommit.RaftAppends"]["count"] == 2
+    finally:
+        if committer is not None:
+            committer.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_double_spend_pair_across_leader_kill(seed):
+    """First spend submitted just as the leader is partitioned away
+    mid-batch; the second spend goes to the successor. SAFETY: at most one
+    of the pair ever reports commit, and the surviving replicas converge
+    on one owner that matches the reported winner. The committer's backend
+    is a follower (it survives the kill and forwards to whichever leader
+    exists), the shape the notary node sees during a real re-election."""
+    cluster = _Cluster(seed)
+    committer = None
+    try:
+        leader = cluster.wait_leader()
+        follower = next(n for n in cluster.nodes if n is not leader)
+        committer = GroupCommitter(follower, timeout_s=8.0, max_batch=4,
+                                   max_latency_s=0.01, prescreen=False)
+        ref = _ref(f"kill-{seed}")
+
+        with inject(*partition_rules(leader.node_id), seed=seed):
+            # submitted into the partition window: its append either dies
+            # with the old leader or retries onto the successor
+            f_a = committer.submit([ref], _tx("a"), "chaos")
+            cluster.wait_leader(exclude=(leader,))
+            f_b = committer.submit([ref], _tx("b"), "chaos")
+
+            outcomes = {}
+            for name, fut in (("a", f_a), ("b", f_b)):
+                try:
+                    fut.result(timeout=20)
+                    outcomes[name] = "committed"
+                except UniquenessException:
+                    outcomes[name] = "conflict"
+                except Exception:
+                    outcomes[name] = "lost"   # timed out in the partition
+
+            committed = [n for n, o in outcomes.items() if o == "committed"]
+            # SAFETY: never both; LIVENESS: the successor commits one
+            assert len(committed) == 1, outcomes
+
+            live = cluster.wait_converged(1, exclude=(leader,))
+            assert live[0]._map[ref].consuming_tx == _tx(committed[0])
+
+        # heal: the old leader rejoins and converges on the same winner
+        winner = _tx(committed[0])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if all(m._map.get(ref) is not None
+                   and m._map[ref].consuming_tx == winner
+                   for m in cluster.maps):
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("old leader never converged after heal")
+    finally:
+        if committer is not None:
+            committer.close()
+        cluster.close()

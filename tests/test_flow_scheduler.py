@@ -1,0 +1,257 @@
+"""FlowScheduler + AwaitFuture/VerifyMany suspension points (ISSUE 11).
+
+The group-commit pipeline hangs off three framework pieces: a generic
+park-on-a-future yield (AwaitFuture — the notary-wait suspension), a
+wave verify yield (VerifyMany — one park for N verifier futures), and a
+bounded-concurrency flow launcher (FlowScheduler). These tests pin their
+contracts directly, with controllable futures instead of live services.
+"""
+import threading
+from concurrent.futures import Future
+
+import pytest
+
+from corda_tpu.flows import FlowException, FlowLogic
+from corda_tpu.flows.api import AwaitFuture, VerifyMany
+from corda_tpu.flows.library import _topological_order, _topological_waves
+from corda_tpu.node.statemachine import FlowScheduler
+from corda_tpu.testing import MockNetwork
+
+
+@pytest.fixture
+def net():
+    network = MockNetwork()
+    a = network.create_node("O=Alice, L=London, C=GB")
+    network.start_nodes()
+    return network, a
+
+
+class AwaitFlow(FlowLogic):
+    def __init__(self, producer):
+        self.producer = producer
+
+    def call(self):
+        value = yield AwaitFuture(self.producer)
+        return value
+
+
+class CatchingAwaitFlow(FlowLogic):
+    """The error must be thrown INTO the flow with its type preserved."""
+
+    def __init__(self, producer):
+        self.producer = producer
+
+    def call(self):
+        try:
+            yield AwaitFuture(self.producer)
+        except ValueError as e:
+            return f"caught:{e}"
+        return "no-error"
+
+
+def test_await_future_none_producer_resumes_immediately(net):
+    network, a = net
+    fsm = a.start_flow(AwaitFlow(lambda: None))
+    network.run_network()
+    assert fsm.result_future.result(timeout=1) is None
+
+
+def test_await_future_done_fast_path(net):
+    network, a = net
+    fut = Future()
+    fut.set_result("ready")
+    fsm = a.start_flow(AwaitFlow(lambda: fut))
+    network.run_network()
+    assert fsm.result_future.result(timeout=1) == "ready"
+
+
+def test_await_future_parks_until_foreign_thread_resolves(net):
+    network, a = net
+    fut = Future()
+    fsm = a.start_flow(AwaitFlow(lambda: fut))
+    assert not fsm.done and a.smm.awaiting_external == 1
+    threading.Timer(0.05, lambda: fut.set_result(42)).start()
+    network.run_network()
+    assert fsm.result_future.result(timeout=1) == 42
+    assert a.smm.awaiting_external == 0
+
+
+def test_await_future_error_type_preserved(net):
+    network, a = net
+    fut = Future()
+    fsm = a.start_flow(CatchingAwaitFlow(lambda: fut))
+    threading.Timer(0.05, lambda: fut.set_exception(ValueError("nope"))).start()
+    network.run_network()
+    assert fsm.result_future.result(timeout=1) == "caught:nope"
+
+
+# ---------------------------------------------------------------------------
+# VerifyMany
+# ---------------------------------------------------------------------------
+
+class FakeVerifier:
+    """Async verifier double: hands back controllable futures per submit."""
+
+    def __init__(self):
+        self.submitted: list = []   # (stx, future)
+
+    def verify_signed(self, stx, hub, check_sufficient_signatures=True):
+        fut = Future()
+        self.submitted.append((stx, fut))
+        return fut
+
+
+class WaveFlow(FlowLogic):
+    def __init__(self, stxs):
+        self.stxs = stxs
+
+    def call(self):
+        try:
+            yield VerifyMany(tuple(self.stxs),
+                             check_sufficient_signatures=False)
+        except Exception as e:
+            return f"failed:{type(e).__name__}:{e}"
+        return "verified"
+
+
+def test_verify_many_empty_wave_is_immediate(net):
+    network, a = net
+    fsm = a.start_flow(WaveFlow([]))
+    network.run_network()
+    assert fsm.result_future.result(timeout=1) == "verified"
+
+
+def test_verify_many_submits_whole_wave_and_parks_once(net):
+    network, a = net
+    fake = FakeVerifier()
+    a.services.verifier_service = fake
+    fsm = a.start_flow(WaveFlow(["stx0", "stx1", "stx2"]))
+    # the whole wave hits the verifier concurrently — no serialization
+    assert [s for s, _ in fake.submitted] == ["stx0", "stx1", "stx2"]
+    # ONE external-wait slot for the wave, resumed by the last arrival
+    assert a.smm.awaiting_external == 1
+    fake.submitted[0][1].set_result(None)
+    fake.submitted[2][1].set_result(None)
+    a.smm.drain_external()
+    assert not fsm.done and a.smm.awaiting_external == 1
+    fake.submitted[1][1].set_result(None)
+    network.run_network()
+    assert fsm.result_future.result(timeout=1) == "verified"
+    assert a.smm.awaiting_external == 0
+
+
+def test_verify_many_throws_first_error_in_submission_order(net):
+    network, a = net
+    fake = FakeVerifier()
+    a.services.verifier_service = fake
+    fsm = a.start_flow(WaveFlow(["stx0", "stx1", "stx2"]))
+    # the LAST submission fails first in wall time; the FIRST submission's
+    # failure is what the yield site must see (deterministic across runs)
+    fake.submitted[2][1].set_exception(IndexError("later"))
+    fake.submitted[0][1].set_exception(ValueError("first"))
+    fake.submitted[1][1].set_result(None)
+    network.run_network()
+    assert fsm.result_future.result(timeout=1) == "failed:ValueError:first"
+
+
+# ---------------------------------------------------------------------------
+# FlowScheduler
+# ---------------------------------------------------------------------------
+
+def _drain(node):
+    """Drain the node's external queue to quiescence (run_network would
+    block while other flows stay deliberately parked on pending futures)."""
+    while node.smm.drain_external():
+        pass
+
+
+def test_scheduler_bounds_concurrency_and_backfills(net):
+    network, a = net
+    sched = FlowScheduler(a.smm, max_concurrent=2)
+    futs = [Future() for _ in range(5)]
+    proxies = [sched.submit(lambda f=f: AwaitFlow(lambda: f)) for f in futs]
+    assert sched.in_flight == 2 and sched.waiting == 3
+
+    futs[0].set_result("r0")
+    _drain(a)   # completion launches the next waiter
+    assert proxies[0].result(timeout=1) == "r0"
+    assert sched.in_flight == 2 and sched.waiting == 2
+
+    for i, fut in enumerate(futs[1:], start=1):
+        fut.set_result(f"r{i}")
+        _drain(a)
+    assert [p.result(timeout=1) for p in proxies] == \
+        ["r0", "r1", "r2", "r3", "r4"]
+    assert sched.in_flight == 0 and sched.waiting == 0
+    assert sched.launched == 5
+    # the bound held: never more than max_concurrent in flight
+    assert sched.high_water == 2
+
+
+def test_scheduler_propagates_flow_failure_to_proxy(net):
+    network, a = net
+    sched = FlowScheduler(a.smm, max_concurrent=2)
+    fut = Future()
+    proxy = sched.submit(lambda: AwaitFlow(lambda: fut))
+    fut.set_exception(FlowException("flow blew up"))
+    _drain(a)
+    with pytest.raises(FlowException, match="blew up"):
+        proxy.result(timeout=1)
+    assert sched.in_flight == 0
+
+
+def test_scheduler_factory_failure_does_not_leak_a_slot(net):
+    network, a = net
+    sched = FlowScheduler(a.smm, max_concurrent=1)
+
+    def bad_factory():
+        raise RuntimeError("cannot build")
+
+    proxy = sched.submit(bad_factory)
+    with pytest.raises(RuntimeError, match="cannot build"):
+        proxy.result(timeout=1)
+    # the slot was released; a follow-up flow still runs
+    ok = sched.submit(lambda: AwaitFlow(lambda: None))
+    _drain(a)
+    assert ok.result(timeout=1) is None
+
+
+# ---------------------------------------------------------------------------
+# Wave-based dependency resolution
+# ---------------------------------------------------------------------------
+
+class _FakeStx:
+    def __init__(self, tx_id, deps=()):
+        self.id = tx_id
+        self.inputs = [type("Ref", (), {"txhash": d})() for d in deps]
+
+
+def test_topological_waves_diamond():
+    txs = {s.id: s for s in [
+        _FakeStx("a"),
+        _FakeStx("b", deps=["a"]),
+        _FakeStx("c", deps=["a"]),
+        _FakeStx("d", deps=["b", "c"]),
+    ]}
+    waves = _topological_waves(txs)
+    assert [sorted(s.id for s in w) for w in waves] == \
+        [["a"], ["b", "c"], ["d"]]
+    order = [s.id for s in _topological_order(txs)]
+    assert order.index("a") < order.index("b") < order.index("d")
+    assert order.index("a") < order.index("c") < order.index("d")
+
+
+def test_topological_waves_external_deps_are_wave_zero():
+    # inputs whose producers are NOT in the fetched set (already in the
+    # vault) must not block the wave cut
+    txs = {s.id: s for s in [_FakeStx("x", deps=["already-recorded"])]}
+    assert [[s.id for s in w] for w in _topological_waves(txs)] == [["x"]]
+
+
+def test_topological_waves_cycle_raises():
+    txs = {s.id: s for s in [
+        _FakeStx("a", deps=["b"]),
+        _FakeStx("b", deps=["a"]),
+    ]}
+    with pytest.raises(FlowException, match="cycle"):
+        _topological_waves(txs)

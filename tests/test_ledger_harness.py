@@ -38,6 +38,20 @@ def test_smoke_scenario_stitches_one_commit_path_trace():
     assert report["ops_failed"] == 0, report
     assert report["exactly_once_ok"] and report["replicas_agree"]
     assert report["stitched_traces"] >= 1
+    # counter reconciliation (ISSUE 11): every committed tx either passed
+    # the notary (it had inputs) or was a self-issue leg with nothing to
+    # check — the counters must account for each other exactly
+    assert report["counter_invariant_ok"], report
+    assert report["committed_tx_count"] == \
+        report["notarised_tx_count"] + report["self_issue_tx_count"]
+    # the notary only ever sees input-bearing transactions
+    assert report["notarised_tx_count"] >= report["notarised_input_tx_count"]
+    # group-commit amortization self-report is present and consistent:
+    # every notarised tx went through the GroupCommitter exactly once
+    assert report["group_commit_committed"] == report["notarised_tx_count"]
+    assert report["group_commit_raft_appends"] == \
+        report["ledger_commit_batch_count"]
+    assert 0.0 < report["raft_appends_per_committed_tx"] <= 1.0
     spans = report["trace_sample"]
     names = {s["name"] for s in spans}
     for required in COMMIT_PATH_SPANS:
