@@ -411,7 +411,7 @@ def fleet_main() -> None:
     federates worker metrics and stitches cross-process traces. Full: one
     device-pinned worker per local chip (the MULTICHIP stage runs the same
     thing through __graft_entry__.dryrun_multichip)."""
-    from corda_tpu.verifier.fleet import fleet_bench
+    from corda_tpu.verifier.fleet import fleet_bench, kill_storm_recovery
     if SMOKE:
         out = fleet_bench(2, groups=24, group_size=16, use_device=False)
         out["smoke"] = True
@@ -423,8 +423,33 @@ def fleet_main() -> None:
         out = fleet_bench(n, groups=32 * n, group_size=256,
                           use_device=True, devices=devices[:n],
                           host_crossover=0)
+        # full runs also prove self-healing: a seeded kill-storm (host
+        # path — the controller seams are device-agnostic) whose measured
+        # recovery time becomes the artifact's recovery_s
+        storm = kill_storm_recovery(seed=7)
+        out["kill_storm"] = storm
+        out["recovery_s"] = storm["recovery_s"] or 0.0
+        out["controller_actions"] = storm["controller_actions"]
     out["fleet"] = True
     problems = []
+    if SMOKE:
+        # an unstressed run must leave the controller idle: state steady,
+        # zero actions, nothing to recover from (benchguard schema-locked)
+        if out.get("controller_state") != "steady":
+            problems.append(f"controller_state={out.get('controller_state')!r}"
+                            f" on an unstressed run (want 'steady')")
+        if out.get("controller_actions") != 0:
+            problems.append(f"controller_actions={out.get('controller_actions')}"
+                            f" on an unstressed run (want 0)")
+    else:
+        storm = out["kill_storm"]
+        if storm["lost_futures"]:
+            problems.append(f"kill-storm lost {storm['lost_futures']} futures")
+        if not storm["recovered_within_bound"]:
+            problems.append(
+                f"kill-storm recovery {storm['recovery_s']}s exceeded the "
+                f"error-budget bound {storm['recovery_bound_s']}s "
+                f"(state {storm['controller_state']})")
     if out["n_workers"] != (2 if SMOKE else max(1, out["n_workers"])):
         problems.append(f"n_workers={out['n_workers']}: fleet did not spawn")
     idle = [w for w, c in out["per_worker_sigs"].items() if c <= 0]

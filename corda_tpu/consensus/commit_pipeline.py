@@ -213,7 +213,8 @@ class GroupCommitter:
             out = consensus_round(
                 self.backend, ("put_all_batch", payload), self.timeout_s,
                 trace_ctx=sp.context() or first_ctx,
-                on_attempt=self._m_appends.mark)
+                on_attempt=self._m_appends.mark,
+                site="raft.submit.group_commit")
             results = out["results"]
         except BaseException as e:
             error = e

@@ -19,14 +19,16 @@ class _LeaderUnknown(RuntimeError):
 
 
 def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
-                    on_attempt=None):
+                    on_attempt=None, site: str = "raft.submit"):
     """One blocking replicated-state-machine round: submit ``command`` to
     `backend` (RaftNode or BFTClient), retrying leaderless windows with
     decorrelated-jitter backoff inside the timeout budget, abandoning the
     pending entry on timeout so the request table cannot leak. Returns the
     apply result verbatim — callers interpret verdicts. ``on_attempt`` (if
     given) is called once per actual submit, the seam the GroupCommitter
-    uses to count real raft appends."""
+    uses to count real raft appends. ``site`` names the retry site on the
+    Retry.* meters, so distinct callers — the per-transaction path vs the
+    GroupCommitter's batched cut — burn visibly separate retry budgets."""
 
     def _submit(ctx):
         kwargs = {}
@@ -48,7 +50,7 @@ def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
             raise
 
     return retry.retry_call(
-        lambda: _submit(trace_ctx), site="raft.submit",
+        lambda: _submit(trace_ctx), site=site,
         policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5, max_attempts=6,
                                  deadline_s=timeout_s),
         retry_on=(_LeaderUnknown,))

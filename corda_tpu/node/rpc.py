@@ -223,6 +223,7 @@ class CordaRPCOps:
         tables, a non-notary node not for raft state."""
         checks: dict = {}
         degraded: dict = {}
+        controller_block: dict | None = None
         svc = self.hub.verifier_service
         batcher = getattr(svc, "batcher", None)
         if batcher is not None:
@@ -264,6 +265,17 @@ class CordaRPCOps:
                     "last_report_age_s": {
                         w: info.get("last_report_age_s")
                         for w, info in fleet["workers"].items()}}
+            ctl = fleet.get("controller")
+            if ctl is not None:
+                # the FleetController's self-report: state, ladder rung,
+                # recent actions — an operator hitting /readyz during an
+                # episode sees exactly which concessions are in force
+                controller_block = ctl
+                if ctl.get("state") != "steady":
+                    degraded["controller"] = {
+                        "state": ctl["state"],
+                        "ladder_step": ctl["ladder_step"],
+                        "actions_total": ctl["actions_total"]}
         notary = getattr(self.hub, "notary_service", None)
         if notary is not None:
             raft = getattr(notary.uniqueness, "raft", None)
@@ -281,6 +293,8 @@ class CordaRPCOps:
             if status["alerting"]:
                 degraded["slo"] = status
         out = {"ready": all(checks.values()), "checks": checks}
+        if controller_block is not None:
+            out["controller"] = controller_block
         if degraded:
             out["degraded"] = degraded
         return out

@@ -116,6 +116,15 @@ class LedgerScenarioConfig:
     #: right after construction — tests use it to force degraded routes
     #: (e.g. trip the device breakers so commits host-verify)
     on_verifier: object = None
+    #: hostile hot-state shape (ROADMAP item 6): when set, every payment
+    #: targets THIS party index — one exchange-like vault absorbing all
+    #: traffic — instead of the uniform random counterparty mix.
+    hot_party: int | None = None
+    #: after the workload drains, replay this many already-consumed input
+    #: refs straight at the uniqueness provider as deliberate double
+    #: spends; the artifact records the rejection rate (1.0 or the
+    #: notary's safety broke).
+    double_spend_replays: int = 0
 
     @staticmethod
     def full(seed: int = 7, chaos: bool = True) -> "LedgerScenarioConfig":
@@ -124,6 +133,27 @@ class LedgerScenarioConfig:
             coins_per_party=6, node_concurrency=4,
             seed=seed, chaos=chaos, max_duration_s=300.0,
             trace_capacity=65536, mode="full")
+
+    @staticmethod
+    def hot_state(seed: int = 7, full: bool = False
+                  ) -> "LedgerScenarioConfig":
+        """The hostile preset: many parties racing to pay ONE exchange-like
+        party, then a burst of deliberate double-spend replays against the
+        refs the run consumed. Settles are off — pure payment pressure on
+        the hot vault — and the artifact carries the rejection rate and
+        the throughput floor benchguard locks."""
+        if full:
+            return LedgerScenarioConfig(
+                parties=16, operations=480, rate_tx_per_sec=80.0,
+                coins_per_party=4, node_concurrency=4,
+                settle_fraction=0.0, hot_party=0, double_spend_replays=48,
+                seed=seed, max_duration_s=300.0, trace_capacity=65536,
+                mode="hot-state")
+        return LedgerScenarioConfig(
+            parties=6, operations=42, rate_tx_per_sec=12.0,
+            coins_per_party=2, settle_fraction=0.0,
+            hot_party=0, double_spend_replays=8,
+            seed=seed, mode="hot-state-smoke")
 
 
 @dataclass
@@ -155,10 +185,18 @@ def _build_ops(cfg: LedgerScenarioConfig) -> list[_Op]:
             ops.append(_Op("issue", len(ops),
                            len(ops) / cfg.rate_tx_per_sec, initiator=i))
     while len(ops) < cfg.operations:
-        seller = rng.randrange(cfg.parties)
-        other = rng.randrange(cfg.parties - 1)
-        if other >= seller:
-            other += 1
+        if cfg.hot_party is not None:
+            # hostile hot-state shape: every spender races against the
+            # one exchange-like party's vault
+            other = cfg.hot_party
+            seller = rng.randrange(cfg.parties - 1)
+            if seller >= other:
+                seller += 1
+        else:
+            seller = rng.randrange(cfg.parties)
+            other = rng.randrange(cfg.parties - 1)
+            if other >= seller:
+                other += 1
         kind = "settle" if rng.random() < cfg.settle_fraction else "pay"
         ops.append(_Op(kind, len(ops), len(ops) / cfg.rate_tx_per_sec,
                        initiator=seller, counterparty=other))
@@ -528,6 +566,31 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
             _finish(op, end_rel, False, err="unfinished at scenario end")
         duration_s = time.monotonic() - started
 
+        # -- deliberate double-spend replays (hot-state preset) ---------------
+        ds_attempted = ds_rejected = 0
+        if cfg.double_spend_replays and committed_notarised:
+            from ..core.crypto.secure_hash import SecureHash
+            from ..node.notary import UniquenessException
+            provider = providers[raft_nodes.index(leader)]
+            rng = random.Random(cfg.seed ^ 0xD5)
+            for k in range(cfg.double_spend_replays):
+                tx_id, refs = committed_notarised[
+                    rng.randrange(len(committed_notarised))]
+                attacker_tx = SecureHash.sha256(
+                    b"double-spend:%d:" % k + tx_id.bytes)
+                ds_attempted += 1
+                try:
+                    provider.commit(list(refs), attacker_tx, "hostile")
+                except UniquenessException as e:
+                    # safety holds only if the conflict names the ORIGINAL
+                    # consumer, not the attacker
+                    if all(e.conflicts.get(r) is not None
+                           and e.conflicts[r].consuming_tx == tx_id
+                           for r in refs):
+                        ds_rejected += 1
+                except Exception:
+                    pass   # a timeout is neither acceptance nor rejection
+
         # -- exactly-once + replica agreement --------------------------------
         exactly_once_ok = True
         for tx_id, refs in committed_notarised:
@@ -635,6 +698,14 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
         # from the stage percentile so benchguard can floor it directly
         report["notary_uniqueness_p99_ms"] = report.get(
             "ledger_stage_notary_uniqueness_ms_p99", 0.0)
+        if cfg.hot_party is not None or cfg.double_spend_replays:
+            report["hot_state"] = True
+            report["hot_party"] = cfg.hot_party
+            report["double_spend_attempts"] = ds_attempted
+            report["double_spend_rejected"] = ds_rejected
+            report["double_spend_rejection_rate"] = (
+                round(ds_rejected / ds_attempted, 4) if ds_attempted
+                else 0.0)
         return report
     finally:
         faults.disarm()

@@ -201,10 +201,15 @@ MULTICHIP_GUARDED: dict = {
 #: stealer should be flattening, steals_total counts its interventions, and
 #: stitched_trace_depth proves cross-process trace stitching actually saw
 #: node- and worker-side spans joined under one trace id.
+#: recovery_s / controller_actions are the FleetController's self-report:
+#: an unstressed (smoke) run must show zero actions and 0.0 recovery —
+#: a controller that acts on a healthy fleet is a regression — while a
+#: full run carries the seeded kill-storm's measured recovery time.
 MULTICHIP_REQUIRED: tuple = (
     "fleet_verifies_per_sec", "scaling_efficiency_pct", "n_workers",
     "n_devices", "fleet_steals", "per_worker_sigs",
     "worker_busy_skew_pct", "steals_total", "stitched_trace_depth",
+    "recovery_s", "controller_actions",
 )
 
 
@@ -452,6 +457,76 @@ def guard_ledger(current: dict,
                 f"{name}: {v:g} > ceiling {g['bound']:.4g} "
                 f"(best {g['best']:g} + {g['tolerance']:.0%} tolerance; "
                 f"lower is better)")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# HOT-STATE (hostile scenario) gate
+# ---------------------------------------------------------------------------
+
+#: Fields a hot-state artifact must carry on top of the LEDGER base
+#: (tools/scenario.py --hot-state): the double-spend self-report and the
+#: throughput floor.
+HOTSTATE_REQUIRED: tuple = (
+    "double_spend_attempts", "double_spend_rejected",
+    "double_spend_rejection_rate", "committed_tx_per_sec",
+)
+
+
+def hotstate_trajectory_paths(root: str | None = None) -> list[str]:
+    root = root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return sorted(_glob.glob(os.path.join(root, "HOTSTATE_r*.json")))
+
+
+def guard_hot_state(current: dict,
+                    trajectory_paths: list[str] | None = None) -> list[str]:
+    """The hostile-scenario gate. HARD invariants regardless of smoke:
+    every deliberate double-spend replay rejected (rate exactly 1.0,
+    naming the original consumer) and a non-zero commit rate — a hot
+    vault that stops committing has been denial-of-serviced by its own
+    safety machinery. Full runs additionally hold the best-so-far
+    throughput floor from the HOTSTATE trajectory."""
+    current = parse_artifact(current)
+    problems = []
+    for name in HOTSTATE_REQUIRED:
+        if name not in current:
+            problems.append(f"missing required hot-state field {name!r}")
+        elif (isinstance(current[name], bool)
+              or not isinstance(current[name], (int, float))):
+            problems.append(f"{name} should be a number, got "
+                            f"{type(current[name]).__name__}")
+    if problems:
+        return problems
+    if current["double_spend_attempts"] < 1:
+        problems.append("no double-spend replays were attempted")
+    if current["double_spend_rejection_rate"] != 1.0:
+        problems.append(
+            f"double_spend_rejection_rate="
+            f"{current['double_spend_rejection_rate']}: the notary "
+            f"accepted (or mis-attributed) a replayed spend")
+    if current["committed_tx_per_sec"] <= 0:
+        problems.append("committed_tx_per_sec=0: the hot vault committed "
+                        "nothing under contention")
+    if current.get("smoke") or str(current.get("mode", "")).endswith("smoke"):
+        return problems
+    paths = (hotstate_trajectory_paths() if trajectory_paths is None
+             else trajectory_paths)
+    best = 0.0
+    for path in sorted(paths):
+        with open(path, encoding="utf-8") as f:
+            run = parse_artifact(json.load(f))
+        v = run.get("committed_tx_per_sec")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            best = max(best, v)
+    if best > 0:
+        floor = best * (1 - RATE_TOLERANCE)
+        v = current["committed_tx_per_sec"]
+        if v < floor:
+            problems.append(
+                f"committed_tx_per_sec: {v:g} < floor {floor:.4g} "
+                f"(best {best:g} - {RATE_TOLERANCE:.0%} tolerance; "
+                f"higher is better)")
     return problems
 
 

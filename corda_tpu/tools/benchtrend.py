@@ -34,7 +34,8 @@ FAMILIES = {
               ("value", "service_path_verifies_per_sec", "vs_baseline",
                "tx_verify_p50_ms_batch1")),
     "multichip": (benchguard.multichip_trajectory_paths,
-                  ("aggregate_verifies_per_sec", "n_devices", "ok")),
+                  ("aggregate_verifies_per_sec", "n_devices", "ok",
+                   "recovery_s")),
     "ledger": (benchguard.ledger_trajectory_paths,
                ("committed_tx_per_sec", "e2e_ms_p99",
                 "notary_uniqueness_p99_ms", "slo_error_budget_pct",

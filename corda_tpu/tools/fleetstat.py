@@ -105,6 +105,30 @@ def render(fleet: dict, metrics: dict) -> str:
         metrics.get("Fleet.agg.SigBatcher.DeviceChecked")
     if isinstance(agg, dict):
         lines.append(f"fleet aggregate checked: {agg.get('count', 0)}")
+    ctl = fleet.get("controller")
+    if isinstance(ctl, dict):
+        state = _cell(ctl.get("state"), "?")
+        rungs = ctl.get("ladder")
+        applied = [s.get("name") for s in rungs
+                   if isinstance(s, dict) and s.get("applied")] \
+            if isinstance(rungs, (list, tuple)) else []
+        lines.append(
+            f"controller: {state}"
+            f"  ladder={'+'.join(applied) if applied else 'none'}"
+            f"  actions={_cell(ctl.get('actions_total'), 0)}"
+            f"  episodes={_cell(ctl.get('episodes'), 0)}"
+            + (f"  recovery_s={ctl['recovery_s_last']}"
+               if isinstance(ctl.get("recovery_s_last"), (int, float))
+               else ""))
+        recent = ctl.get("recent_actions")
+        if isinstance(recent, (list, tuple)) and recent:
+            tail = [a for a in recent[-3:] if isinstance(a, dict)]
+            if tail:
+                lines.append("  recent: " + "; ".join(
+                    f"{a.get('action', '?')}"
+                    + (f"({a.get('step') or a.get('worker')})"
+                       if (a.get('step') or a.get('worker')) else "")
+                    for a in tail))
     return "\n".join(lines)
 
 

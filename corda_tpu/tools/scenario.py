@@ -31,6 +31,11 @@ def build_config(argv=None):
     ap.add_argument("--chaos", action="store_true",
                     help="arm the partition / leader-kill / append-drop "
                          "fault windows")
+    ap.add_argument("--hot-state", action="store_true",
+                    help="hostile preset: every payment targets one "
+                         "exchange-like party, then a deliberate "
+                         "double-spend replay burst (combine with --full "
+                         "for the measured shape)")
     ap.add_argument("--parties", type=int, default=None)
     ap.add_argument("--ops", type=int, default=None,
                     help="total operations (issue ops included)")
@@ -41,8 +46,13 @@ def build_config(argv=None):
                     help="uniqueness-provider commit timeout (seconds)")
     args = ap.parse_args(argv)
 
-    cfg = LedgerScenarioConfig.full(chaos=args.chaos) if args.full \
-        else LedgerScenarioConfig(chaos=args.chaos)
+    if args.hot_state:
+        cfg = LedgerScenarioConfig.hot_state(full=args.full)
+        cfg.chaos = args.chaos
+    elif args.full:
+        cfg = LedgerScenarioConfig.full(chaos=args.chaos)
+    else:
+        cfg = LedgerScenarioConfig(chaos=args.chaos)
     if args.parties is not None:
         cfg.parties = args.parties
     if args.ops is not None:
@@ -62,6 +72,11 @@ def main(argv=None) -> int:
     report = run_ledger_scenario(build_config(argv))
     print(json.dumps(report, indent=2, sort_keys=True, default=str))
     ok = report["exactly_once_ok"] and report["replicas_agree"]
+    if report.get("hot_state"):
+        # the hostile gate: every deliberate double spend rejected, and
+        # the hot vault still committed real throughput
+        ok = ok and report["double_spend_rejection_rate"] == 1.0 \
+            and report["committed_tx_per_sec"] > 0
     return 0 if ok else 1
 
 

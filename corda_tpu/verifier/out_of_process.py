@@ -40,6 +40,7 @@ from ..network.messaging import (TOPIC_VERIFIER_REQUESTS,
                                  TOPIC_VERIFIER_RESPONSES, TopicSession)
 from ..observability import (FleetMetricsFederation, RequestLog, get_tracer,
                              make_span_dict)
+from ..observability.slog import jlog
 from ..utils import retry
 from ..utils.faults import DROP, fault_point
 from ..utils.metrics import MetricRegistry
@@ -227,6 +228,11 @@ class VerifierRequestQueue:
     #: the victim crashed (detach requeues its work anyway) or the ack got
     #: lost; either way the victim becomes stealable again.
     STEAL_TIMEOUT_S = 2.0
+    #: Smoothing for the per-worker service-rate EWMA (signatures/s,
+    #: updated on every acknowledge): high enough to track a worker that
+    #: slowed down mid-run, low enough that one lucky tiny batch does not
+    #: whipsaw the router.
+    EWMA_ALPHA = 0.3
 
     def __init__(self, network_service, redelivery_timeout_s: float | None = None,
                  metrics: MetricRegistry | None = None):
@@ -249,6 +255,10 @@ class VerifierRequestQueue:
         self._affinity: dict[str, str] = {}
         self._steal_inflight: dict[str, float] = {}
         self._gauged: set[str] = set()
+        # predictive routing state: per-worker completed-signature rate
+        # EWMA (from acknowledge timing) + the previous acknowledge time
+        self._ewma_rate: dict[str, float] = {}
+        self._last_ack: dict[str, float] = {}
         # fleet observability plane: per-request lifecycle timelines
         # (/debug/requests + request.* jlog events) and the worker-metrics
         # federation whose families ride every metrics snapshot
@@ -321,6 +331,8 @@ class VerifierRequestQueue:
             self._shards.pop(worker, None)
             self._affinity.pop(worker, None)
             self._steal_inflight.pop(worker, None)
+            self._ewma_rate.pop(worker, None)
+            self._last_ack.pop(worker, None)
         self.federation.detach(worker)
         for req in held:
             self.request_log.append(req.verification_id, "requeued",
@@ -458,6 +470,15 @@ class VerifierRequestQueue:
                                           (None, 0.0))[1] > since)
         return (base + dealt) / max(1, self._capacity.get(worker, 1))
 
+    def _service_rate_ref_locked(self) -> float | None:
+        """Median of the known per-worker service-rate EWMAs — the
+        neutral rate assumed for workers with no completion history yet
+        (None while NO worker has one: routing falls back to raw load)."""
+        rates = sorted(r for r in self._ewma_rate.values() if r > 0.0)
+        if not rates:
+            return None
+        return rates[len(rates) // 2]
+
     def _pick_worker_locked(self, req: VerificationRequest,
                             now: float) -> tuple[str, str, dict]:
         """The router: workers within ROUTE_SLACK of the least estimated
@@ -465,18 +486,31 @@ class VerifierRequestQueue:
         dealt bucket matches this request's dominant scheme (a warm batcher
         queue coalesces same-scheme groups into fuller device batches);
         round-robin breaks the remaining tie so light load keeps the old
-        fair dealing. Returns ``(pick, reason, est-load vector)`` — the
-        decision record the request's lifecycle timeline keeps, so a
-        misrouted request is debuggable from the loads the router SAW."""
+        fair dealing.
+
+        PREDICTIVE refinement: once acknowledge timing has produced
+        service-rate EWMAs, each worker's load is scaled by (median rate /
+        its rate) — i.e. compared by predicted *drain time*, not snapshot
+        depth, so a worker that completes twice as fast legitimately
+        carries twice the queue before the router balks. Returns ``(pick,
+        reason, est-load vector)`` — the decision record the request's
+        lifecycle timeline keeps, so a misrouted request is debuggable
+        from the loads the router SAW."""
         if len(self._workers) == 1:
             only = self._workers[0]
             return only, "single-worker", {
                 only: round(self._est_load_locked(only, now), 2)}
         loads = {w: self._est_load_locked(w, now) for w in self._workers}
+        ref = self._service_rate_ref_locked()
+        reason = "least-loaded-rr"
+        if ref is not None:
+            loads = {w: (v * (ref / self._ewma_rate[w])
+                         if self._ewma_rate.get(w, 0.0) > 0.0 else v)
+                     for w, v in loads.items()}
+            reason = "predictive-ewma"
         best = min(loads.values())
         slack = max(self.ROUTE_SLACK, best * 0.25)
         candidates = [w for w in self._workers if loads[w] <= best + slack]
-        reason = "least-loaded-rr"
         bucket = _dominant_bucket(req.signatures)
         if bucket is not None:
             affine = [w for w in candidates
@@ -532,16 +566,36 @@ class VerifierRequestQueue:
     def acknowledge(self, verification_id: int) -> str | None:
         """Retire a completed request from its worker's outstanding list;
         returns the worker it was charged to (None for an unknown or
-        already-acknowledged id)."""
+        already-acknowledged id). Acknowledge timing feeds the worker's
+        service-rate EWMA (signatures completed per second between
+        consecutive acknowledges) — the predictive-routing signal."""
         with self._lock:
             worker, _ = self._dealt_at.pop(verification_id, (None, 0.0))
             if worker is None:
                 return None
-            self._last_activity[worker] = time.monotonic()
+            now = time.monotonic()
+            self._last_activity[worker] = now
             held = self._outstanding.get(worker, [])
+            weight = next((_weight(r) for r in held
+                           if r.verification_id == verification_id), 1)
             self._outstanding[worker] = [
                 r for r in held if r.verification_id != verification_id]
+            prev_t = self._last_ack.get(worker)
+            self._last_ack[worker] = now
+            if prev_t is not None:
+                inst = weight / max(1e-6, now - prev_t)
+                prev = self._ewma_rate.get(worker)
+                self._ewma_rate[worker] = (
+                    inst if prev is None
+                    else self.EWMA_ALPHA * inst
+                    + (1.0 - self.EWMA_ALPHA) * prev)
         return worker
+
+    def service_rates(self) -> dict:
+        """Per-worker service-rate EWMA snapshot (signatures/s) — the
+        controller's and fleet_status's view of the predictive signal."""
+        with self._lock:
+            return {w: round(r, 2) for w, r in self._ewma_rate.items()}
 
     def _drain(self) -> None:
         while True:
@@ -584,7 +638,8 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
     def __init__(self, network_service, metrics: MetricRegistry | None = None,
                  redelivery_timeout_s: float | None = None,
                  expected_workers: int | None = None,
-                 load_report_interval_s: float | None = None):
+                 load_report_interval_s: float | None = None,
+                 stale_detach_intervals: int | None = None):
         self.metrics = metrics if metrics is not None else MetricRegistry()
         self.network_service = network_service
         # expected fleet size (config): /readyz compares attached against it
@@ -594,6 +649,15 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
         # flags a worker silent past 3× it as stale/degraded (None = the
         # deployment has no report loop, staleness is not judged)
         self.load_report_interval_s = load_report_interval_s
+        # after this many CONSECUTIVE stale windows (each 3× the report
+        # interval) of total silence, the worker is presumed wedged and
+        # crash-detached — its charged work requeues instead of hanging
+        # behind a worker that merely LOOKS attached. None = flag-only
+        # (the pre-controller behavior).
+        self.stale_detach_intervals = stale_detach_intervals
+        # the FleetController driving this service, when one is attached
+        # (fleet_status / readyz surface its status block)
+        self.controller = None
         self.queue = VerifierRequestQueue(
             network_service, redelivery_timeout_s=redelivery_timeout_s,
             metrics=self.metrics)
@@ -624,18 +688,66 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
                 self.queue.detach_worker(recipient)
 
             network_service.on_send_failure = _send_failed
+        periods = []
         if redelivery_timeout_s is not None:
+            periods.append(redelivery_timeout_s / 2)
+        if (stale_detach_intervals is not None
+                and load_report_interval_s is not None):
+            periods.append(stale_detach_intervals * 3.0
+                           * load_report_interval_s / 2)
+        if periods:
+            self._scan_period_s = min(periods)
             self._scanner = threading.Thread(
                 target=self._scan_overdue, daemon=True,
                 name="verifier-redelivery")
             self._scanner.start()
 
     def _scan_overdue(self) -> None:
-        while not self._stopping.wait(self.queue.redelivery_timeout_s / 2):
+        while not self._stopping.wait(self._scan_period_s):
             try:
                 self.queue.requeue_overdue()
+                self.reap_stale_workers()
             except Exception:
                 log.exception("overdue-redelivery scan failed")
+
+    def reap_stale_workers(self, now: float | None = None) -> list[str]:
+        """Crash-detach workers whose load reports went silent for
+        ``stale_detach_intervals`` consecutive stale windows (each 3× the
+        report interval — the same window ``fleet_status`` flags at). The
+        detach rides the standard crash path, so everything the wedged
+        worker held requeues to the survivors and every future still
+        resolves exactly once. No-op (returns []) unless both
+        ``load_report_interval_s`` and ``stale_detach_intervals`` are
+        configured. Called by the redelivery scanner and every controller
+        tick; deterministic tests call it by hand with an explicit
+        ``now``."""
+        interval = self.load_report_interval_s
+        n = self.stale_detach_intervals
+        if interval is None or n is None:
+            return []
+        if now is None:
+            now = time.monotonic()
+        horizon = n * 3.0 * interval
+        q = self.queue
+        doomed: list[tuple[str, float]] = []
+        with q._lock:
+            for w in list(q._workers):
+                rep = q._reports.get(w)
+                seen = rep[1] if rep is not None \
+                    else q._last_activity.get(w, now)
+                # a worker whose results are still acknowledging is alive
+                # even when its reports lag (GIL stalls under host verify
+                # delay the report pump long before work actually stops)
+                seen = max(seen, q._last_ack.get(w, 0.0))
+                if now - seen > horizon:
+                    doomed.append((w, now - seen))
+        for w, age in doomed:
+            jlog(log, "fleet.stale_detach", level=logging.WARNING,
+                 worker=w, silent_s=round(age, 3),
+                 stale_windows=n, window_s=round(3.0 * interval, 3))
+            self.metrics.meter("Fleet.StaleDetached").mark()
+            q.detach_worker(w)
+        return [w for w, _ in doomed]
 
     def shutdown(self) -> None:
         self._stopping.set()
@@ -664,18 +776,25 @@ class OutOfProcessTransactionVerifierService(TransactionVerifierService):
                             and now - seen > 3.0 * interval)
                 if is_stale:
                     stale.append(w)
+                rate = q._ewma_rate.get(w)
                 workers[w] = {
                     "device_shard": list(q._shards.get(w, ())),
                     "capacity": q._capacity.get(w, 1),
                     "queue_depth": q._queue_depth_of(w),
                     "last_report_age_s": (round(age, 3)
                                           if age is not None else None),
+                    "service_rate_ewma": (round(rate, 2)
+                                          if rate is not None else None),
                     "stale": is_stale}
         out = {"expected": self.expected_workers, "attached": len(workers),
                "workers": workers, "stale": stale}
+        if self.stale_detach_intervals is not None:
+            out["stale_detach_intervals"] = self.stale_detach_intervals
         out["degraded"] = bool(stale) or (
             self.expected_workers is not None
             and len(workers) < self.expected_workers)
+        if self.controller is not None:
+            out["controller"] = self.controller.status()
         return out
 
     @property

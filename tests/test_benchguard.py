@@ -132,6 +132,7 @@ def _fleet(**over):
         "fleet_steals": 3, "fleet_stolen": 12,
         "worker_busy_skew_pct": 4.0, "steals_total": 3,
         "stitched_trace_depth": 4,
+        "recovery_s": 0.0, "controller_actions": 0,
         "per_worker_sigs": {"w0": 4096, "w1": 4096},
     }
     base.update(over)

@@ -171,6 +171,85 @@ def test_double_spend_pair_adjacent_batches(seed):
 
 
 @pytest.mark.parametrize("seed", SEEDS)
+def test_leader_kill_cut_batch_retries_on_group_commit_site(seed):
+    """A leader killed while a cut batch is in flight: the committer's
+    ``consensus_round`` attempts ride utils/retry.py under the
+    GroupCommitter's OWN retry site
+    (``Retry.Attempts.raft.submit.group_commit``), and the election
+    window neither duplicates nor loses a verdict. A future that times
+    out inside the partition is NOT a lost verdict — after the heal a
+    probe spend of the same ref must get a definitive answer that
+    matches the replicated map exactly-once: either the original commit
+    landed (probe conflicts against it) or it never did (probe wins)."""
+    from corda_tpu.utils import retry as retry_mod
+
+    cluster = _Cluster(seed)
+    committer = None
+    site_meter = "Retry.Attempts.raft.submit.group_commit"
+    before = retry_mod.snapshot().get(site_meter, {}).get("count", 0)
+    try:
+        leader = cluster.wait_leader()
+        follower = next(n for n in cluster.nodes if n is not leader)
+        committer = GroupCommitter(follower, timeout_s=6.0, max_batch=4,
+                                   max_latency_s=0.01, prescreen=False)
+        refs = [_ref(f"site-{seed}-{i}") for i in range(3)]
+        txs = [_tx(f"site-{seed}-{i}") for i in range(3)]
+        with inject(*partition_rules(leader.node_id), seed=seed):
+            futures = [committer.submit([r], tx, "chaos")
+                       for r, tx in zip(refs, txs)]
+            cluster.wait_leader(exclude=(leader,))
+            outcomes = []
+            for f in futures:
+                try:
+                    f.result(timeout=20)
+                    outcomes.append("committed")
+                except UniquenessException:
+                    pytest.fail("distinct refs can never conflict "
+                                "with each other")
+                except Exception:
+                    outcomes.append("pending")   # timed out in the window
+        # heal, then resolve every pending verdict with a probe spend
+        cluster.wait_leader()
+        for i, out in enumerate(outcomes):
+            if out == "committed":
+                continue
+            probe = committer.submit([refs[i]], _tx(f"probe-{seed}-{i}"),
+                                     "chaos")
+            try:
+                probe.result(timeout=20)
+                outcomes[i] = "never_landed"   # probe won: original lost
+            except UniquenessException as ei:
+                # original landed despite the client timeout: the map
+                # must hold exactly the original tx, not the probe
+                assert ei.value.conflicts[refs[i]].consuming_tx == txs[i]
+                outcomes[i] = "committed"
+        # exactly-once on every replica that saw the final history
+        for i, out in enumerate(outcomes):
+            if out == "committed":
+                want = txs[i]
+            else:
+                want = _tx(f"probe-{seed}-{i}")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                owners = {m._map[refs[i]].consuming_tx
+                          for m in cluster.maps if refs[i] in m._map}
+                if owners == {want}:
+                    break
+                time.sleep(0.01)
+            else:
+                raise AssertionError(
+                    f"ref {i} owners never converged on the "
+                    f"{out} verdict")
+        # the cut batch's appends metered under the committer's own site
+        after = retry_mod.snapshot().get(site_meter, {}).get("count", 0)
+        assert after - before >= 1
+    finally:
+        if committer is not None:
+            committer.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
 def test_double_spend_pair_across_leader_kill(seed):
     """First spend submitted just as the leader is partitioned away
     mid-batch; the second spend goes to the successor. SAFETY: at most one

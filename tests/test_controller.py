@@ -1,0 +1,282 @@
+"""FleetController unit suite: a fake clock, a scripted SLO, and stub
+actuators — every control-loop property pinned without threads or sleep.
+
+The properties under test are the ones that make the controller safe to
+leave unattended: it does NOTHING while the SLO is healthy, it escalates
+capacity before concessions, the degradation ladder applies in order and
+reverts in reverse order, reversal demands a SUSTAINED healthy streak
+(no flapping on an oscillating signal), scale-down never runs while the
+error budget is scorched, and stale workers are reaped in any state.
+"""
+import pytest
+
+from corda_tpu.utils.metrics import MetricRegistry
+from corda_tpu.verifier.controller import (ControllerConfig, FleetController,
+                                           LadderStep, apply_degradations,
+                                           batcher_ladder)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+class FakeSLO:
+    """Scripted burn state: tests flip ``alerting`` / ``budget_pct``."""
+
+    def __init__(self):
+        self.alerting = False
+        self.budget_pct = 100.0
+        self.objectives = ("availability",)
+
+    def alerts(self):
+        if not self.alerting:
+            return []
+        return [{"objective": "availability", "severity": "page",
+                 "burn_rate": 20.0, "windows_s": (60, 3600)}]
+
+    def error_budget_pct(self, obj):
+        return self.budget_pct
+
+
+class Rung:
+    def __init__(self, name, trail):
+        self.step = LadderStep(name,
+                               apply=lambda: trail.append(f"+{name}"),
+                               revert=lambda: trail.append(f"-{name}"))
+
+
+def build(slo=None, workers=2, ladder=(), reaped=None, **cfg_kw):
+    """A controller over stub seams. Returns (controller, clock, state)
+    where ``state`` records worker count and the action trail."""
+    clock = FakeClock()
+    state = {"workers": workers, "depth": 0.0, "trail": [],
+             "reaped": list(reaped or ()), "breakers": 0}
+
+    def spawn():
+        state["workers"] += 1
+        state["trail"].append("spawn")
+        return f"w{state['workers']}"
+
+    def retire():
+        state["workers"] -= 1
+        state["trail"].append("retire")
+        return f"w{state['workers'] + 1}"
+
+    def reap():
+        out, state["reaped"] = state["reaped"], []
+        return out
+
+    cfg_kw.setdefault("scale_cooldown_s", 1.0)
+    cfg_kw.setdefault("step_cooldown_s", 1.0)
+    cfg_kw.setdefault("healthy_ticks", 3)
+    ctl = FleetController(
+        slo=slo, worker_count=lambda: state["workers"],
+        queue_depth=lambda: state["depth"],
+        spawn=spawn, retire=retire, reap_stale=reap,
+        breaker_open_count=lambda: state["breakers"],
+        ladder=ladder, config=ControllerConfig(**cfg_kw),
+        clock=clock, metrics=MetricRegistry())
+    return ctl, clock, state
+
+
+def tick_n(ctl, clock, n, dt=1.0):
+    acts = []
+    for _ in range(n):
+        clock.advance(dt)
+        acts.extend(ctl.tick())
+    return acts
+
+
+def test_healthy_slo_means_zero_actions():
+    slo = FakeSLO()
+    ctl, clock, state = build(slo=slo, workers=2, min_workers=1,
+                              max_workers=8)
+    acts = tick_n(ctl, clock, 20)
+    assert acts == []
+    assert ctl.actions_total == 0
+    assert ctl.state == "steady"
+    assert state["workers"] == 2
+    assert state["trail"] == []
+
+
+def test_scale_up_before_ladder_and_one_action_per_cooldown():
+    slo = FakeSLO()
+    trail = []
+    ladder = (Rung("shed_bulk", trail).step,)
+    ctl, clock, state = build(slo=slo, workers=1, max_workers=3,
+                              ladder=ladder)
+    slo.alerting = True
+    tick_n(ctl, clock, 2)
+    # capacity first: both scale-ups happen before any concession
+    assert state["trail"] == ["spawn", "spawn"]
+    assert trail == []
+    assert ctl.state == "stressed"
+    # at max_workers the ladder engages
+    tick_n(ctl, clock, 1)
+    assert trail == ["+shed_bulk"]
+    assert ctl.state == "degraded"
+
+
+def test_scale_cooldown_limits_spawn_rate():
+    slo = FakeSLO()
+    ctl, clock, state = build(slo=slo, workers=1, max_workers=8,
+                              scale_cooldown_s=10.0)
+    slo.alerting = True
+    tick_n(ctl, clock, 5, dt=1.0)       # 5 s elapsed < cooldown
+    assert state["trail"].count("spawn") == 1
+    tick_n(ctl, clock, 6, dt=1.0)       # crosses the 10 s cooldown once
+    assert state["trail"].count("spawn") == 2
+
+
+def test_ladder_applies_in_order_and_reverts_in_reverse():
+    slo = FakeSLO()
+    trail = []
+    ladder = tuple(Rung(n, trail).step for n in
+                   ("shed_bulk", "shrink_ladder", "host_route"))
+    ctl, clock, state = build(slo=slo, workers=2, max_workers=2,
+                              ladder=ladder)
+    slo.alerting = True
+    tick_n(ctl, clock, 3)
+    assert trail == ["+shed_bulk", "+shrink_ladder", "+host_route"]
+    assert ctl.ladder_step == 3
+    assert ctl.state == "degraded"
+    # recovery: reverts walk back-to-front, one per healthy streak window
+    slo.alerting = False
+    trail.clear()
+    tick_n(ctl, clock, 12)
+    assert trail == ["-host_route", "-shrink_ladder", "-shed_bulk"]
+    assert ctl.ladder_step == 0
+    assert ctl.state == "steady"
+
+
+def test_no_flap_reversal_requires_sustained_health():
+    slo = FakeSLO()
+    trail = []
+    ladder = (Rung("shed_bulk", trail).step,)
+    ctl, clock, state = build(slo=slo, workers=2, max_workers=2,
+                              ladder=ladder, healthy_ticks=3)
+    slo.alerting = True
+    tick_n(ctl, clock, 1)
+    assert trail == ["+shed_bulk"]
+    # oscillate: 2 healthy ticks, then an alert blip, forever — the
+    # healthy streak never reaches 3, so the rung must NEVER revert
+    for _ in range(6):
+        slo.alerting = False
+        tick_n(ctl, clock, 2)
+        slo.alerting = True
+        tick_n(ctl, clock, 1)
+    assert "-shed_bulk" not in trail
+    assert ctl.ladder_step == 1
+
+
+def test_scale_down_waits_for_budget_and_only_returns_spawned():
+    slo = FakeSLO()
+    ctl, clock, state = build(slo=slo, workers=2, min_workers=1,
+                              max_workers=8, budget_scale_down_pct=50.0)
+    # burn: the controller grows the fleet it will later shrink
+    slo.alerting = True
+    tick_n(ctl, clock, 2)
+    assert state["workers"] == 4
+    # alerts clear but the budget is still scorched: no give-back yet
+    slo.alerting = False
+    slo.budget_pct = 10.0
+    tick_n(ctl, clock, 10)
+    assert state["trail"].count("retire") == 0
+    assert state["workers"] == 4
+    # budget heals → the two SPAWNED workers return; the operator's
+    # baseline two are never touched even though min_workers is 1
+    slo.budget_pct = 90.0
+    tick_n(ctl, clock, 30)
+    assert state["workers"] == 2
+    assert state["trail"].count("retire") == 2
+    assert ctl.state == "steady"
+    tick_n(ctl, clock, 10)
+    assert state["workers"] == 2
+
+
+def test_stale_reap_runs_in_any_state_and_counts_as_action():
+    slo = FakeSLO()
+    ctl, clock, state = build(slo=slo, workers=3,
+                              reaped=["w1", "w2"])
+    acts = tick_n(ctl, clock, 1)
+    kinds = [a["action"] for a in acts]
+    assert kinds == ["stale_detach", "stale_detach"]
+    assert {a["worker"] for a in acts} == {"w1", "w2"}
+    assert ctl.actions_total == 2
+    # the detaches opened an episode; sustained health closes it
+    tick_n(ctl, clock, 10)
+    assert ctl.state == "steady"
+    assert ctl.status()["recovery_s_last"] is not None
+
+
+def test_queue_trend_alone_triggers_stress_without_slo():
+    ctl, clock, state = build(slo=None, workers=1, max_workers=4,
+                              queue_high=100.0, queue_low=10.0)
+    state["depth"] = 100_000.0
+    tick_n(ctl, clock, 3)
+    assert state["trail"].count("spawn") >= 1
+    assert ctl.state == "stressed"
+    state["depth"] = 0.0
+    tick_n(ctl, clock, 40)
+    assert ctl.state == "steady"
+    assert state["workers"] == 1
+
+
+def test_status_shape():
+    slo = FakeSLO()
+    ctl, clock, state = build(slo=slo, workers=2)
+    tick_n(ctl, clock, 1)
+    st = ctl.status()
+    for key in ("state", "workers", "queue_depth_trend", "ladder",
+                "ladder_step", "actions_total", "recent_actions",
+                "episodes", "recovery_s_last", "healthy_streak"):
+        assert key in st, key
+    assert st["state"] == "steady"
+    assert st["workers"] == 2
+    assert st["actions_total"] == 0
+
+
+def test_batcher_ladder_tracks_live_batcher_list():
+    class FakeBatcher:
+        def __init__(self):
+            self.calls = []
+
+        def shed_bulk(self, on):
+            self.calls.append(("shed_bulk", on))
+
+        def shrink_ladder(self, on):
+            self.calls.append(("shrink_ladder", on))
+
+        def route_interactive_host(self, on):
+            self.calls.append(("route_interactive_host", on))
+
+    batchers = [FakeBatcher()]
+    ladder = batcher_ladder(batchers)
+    assert [s.name for s in ladder] == \
+        ["shed_bulk", "shrink_ladder", "host_route_interactive"]
+    ladder[0].apply()
+    ladder[0].applied = True
+    # a batcher appended AFTER the rung applied still gets the revert
+    late = FakeBatcher()
+    batchers.append(late)
+    apply_degradations(ladder, late)    # spawned mid-episode: inherit
+    assert late.calls == [("shed_bulk", True)]
+    ladder[0].revert()
+    assert ("shed_bulk", False) in late.calls
+    assert batchers[0].calls == [("shed_bulk", True), ("shed_bulk", False)]
+
+
+def test_breaker_open_counts_as_stress():
+    ctl, clock, state = build(slo=None, workers=1, max_workers=2)
+    state["breakers"] = 1
+    tick_n(ctl, clock, 2)
+    assert state["trail"].count("spawn") == 1
+    assert ctl.state == "stressed"

@@ -90,3 +90,30 @@ def test_render_missing_metric_family_zeroes_columns():
     w1 = next(l for l in screen.splitlines() if l.startswith("w1"))
     assert "7" in w0
     assert w1.split()[-4:] == ["0", "0", "0", "0"]
+
+
+def test_render_controller_block():
+    fleet = dict(FLEET, controller={
+        "state": "degraded",
+        "ladder": [{"name": "shed_bulk", "applied": True},
+                   {"name": "shrink_ladder", "applied": True},
+                   {"name": "host_route_interactive", "applied": False}],
+        "actions_total": 5, "episodes": 1, "recovery_s_last": 2.75,
+        "recent_actions": [
+            {"action": "scale_up", "worker": "w2"},
+            {"action": "apply_step", "step": "shed_bulk"},
+            {"action": "apply_step", "step": "shrink_ladder"}]})
+    screen = render(fleet, METRICS)
+    assert "controller: degraded" in screen
+    assert "ladder=shed_bulk+shrink_ladder" in screen
+    assert "actions=5" in screen and "episodes=1" in screen
+    assert "recovery_s=2.75" in screen
+    assert "recent: scale_up(w2); apply_step(shed_bulk); " \
+        "apply_step(shrink_ladder)" in screen
+
+
+def test_render_controller_block_survives_garbage():
+    for ctl in ("oops", 42, {"state": None, "ladder": "x",
+                             "recent_actions": [None, "bad", {}]}):
+        screen = render(dict(FLEET, controller=ctl), METRICS)
+        assert "w0" in screen      # worker table still renders

@@ -108,3 +108,33 @@ def test_degraded_breaker_open_route_still_stitches():
     snap = captured["metrics"].snapshot()
     routed = snap.get("SigBatcher.BreakerRouted", {})
     assert routed.get("count", 0) > 0, sorted(snap)
+
+
+@pytest.mark.ledger
+def test_hot_state_preset_rejects_every_double_spend():
+    """The hostile preset (scenario.py --hot-state): every payment races
+    against ONE exchange-like party, then deliberate double-spend replays
+    of already-consumed refs hit the uniqueness provider directly. The
+    notary must reject all of them naming the original consumer, the hot
+    vault must still commit real throughput, and the artifact must clear
+    benchguard's hot-state gate."""
+    from corda_tpu.observability.ledger_harness import _build_ops
+    from corda_tpu.tools.benchguard import guard_hot_state
+
+    cfg = LedgerScenarioConfig.hot_state()
+    cfg.operations = 28          # trimmed for tier-1 wall clock
+    cfg.double_spend_replays = 6
+    # the shape itself: every post-issue op targets the hot party
+    spends = [o for o in _build_ops(cfg) if o.kind != "issue"]
+    assert spends and all(o.counterparty == cfg.hot_party for o in spends)
+    assert all(o.initiator != cfg.hot_party for o in spends)
+
+    report = run_ledger_scenario(cfg)
+    assert report["hot_state"] is True
+    assert report["ops_failed"] == 0, report
+    assert report["exactly_once_ok"] and report["replicas_agree"]
+    assert report["double_spend_attempts"] == 6
+    assert report["double_spend_rejected"] == 6
+    assert report["double_spend_rejection_rate"] == 1.0
+    assert report["committed_tx_per_sec"] > 0
+    assert guard_hot_state(report) == []
