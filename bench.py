@@ -520,6 +520,14 @@ def ledger_main() -> None:
                         "(commit-path span stitching broken)")
     if out["ops_committed"] <= 0:
         problems.append("no operation committed")
+    # blame conservation: the critical-path decomposition must account
+    # for each class's e2e (runs under smoke too — the smoke gate is the
+    # only CPU-tier proof the extractor still covers the whole path)
+    from corda_tpu.tools.benchguard import ledger_critpath_violations
+    problems.extend(ledger_critpath_violations(out))
+    if out["stitched_traces"] >= 1 and out.get("ledger_critpath_traces", 0) < 1:
+        problems.append("stitched traces exist but the critical-path "
+                        "extractor decomposed none of them")
     if problems:
         for p in problems:
             print(f"BENCH INVALID: {p}", file=sys.stderr)

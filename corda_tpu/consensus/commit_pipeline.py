@@ -51,15 +51,18 @@ from .provider import consensus_round
 class _Req:
     """One queued uniqueness-commit request."""
 
-    __slots__ = ("refs", "tx_id", "caller", "trace_ctx", "future", "span")
+    __slots__ = ("refs", "tx_id", "caller", "trace_ctx", "future", "span",
+                 "t_enq")
 
-    def __init__(self, refs, tx_id, caller, trace_ctx, future, span):
+    def __init__(self, refs, tx_id, caller, trace_ctx, future, span,
+                 t_enq=0.0):
         self.refs = refs
         self.tx_id = tx_id
         self.caller = caller
         self.trace_ctx = trace_ctx
         self.future = future
         self.span = span
+        self.t_enq = t_enq      # wall-clock enqueue time (wait-state span)
 
 
 class GroupCommitter:
@@ -141,7 +144,7 @@ class GroupCommitter:
                         reject = UniquenessException(conflicts)
                 if reject is None and any(r in self._pending for r in refs):
                     self._deferred.append(
-                        (refs, tx_id, caller, trace_ctx, fut))
+                        (refs, tx_id, caller, trace_ctx, fut, _time.time()))
                     self._m_deferred.mark()
                     return
             if reject is None:
@@ -155,7 +158,8 @@ class GroupCommitter:
                     self._t_first = now
                 self._t_last = now
                 self._queue.append(
-                    _Req(refs, tx_id, caller, trace_ctx, fut, span))
+                    _Req(refs, tx_id, caller, trace_ctx, fut, span,
+                         t_enq=_time.time()))
                 do_flush = len(self._queue) >= self.max_batch
         if reject is not None:
             self._m_prescreened.mark()
@@ -205,6 +209,7 @@ class GroupCommitter:
                                reason=reason)
         trace_id = getattr(sp.context() or first_ctx, "trace_id", None)
         self._batch_size_hist.update(float(len(reqs)), trace_id=trace_id)
+        round_t0 = _time.time()
         t0 = _time.perf_counter()
         results = None
         error = None
@@ -223,9 +228,28 @@ class GroupCommitter:
             sp.finish()
             self._raft_commit_hist.update(_time.perf_counter() - t0,
                                           trace_id=trace_id)
-        self._finish_batch(reqs, results, error)
+        self._finish_batch(reqs, results, error,
+                           round_t0=round_t0, round_t1=_time.time())
 
-    def _finish_batch(self, reqs, results, error):
+    def _record_wait(self, parent, name: str, kind: str, t0, t1,
+                     **tags) -> None:
+        """Retroactive wait-state span under a request's ``raft.commit``
+        span: decomposes enqueue→verdict into cutter-queue time vs the
+        consensus round actually in flight (critpath.py blame input)."""
+        if not t0 or not t1 or t1 <= t0:
+            return
+        self._tracer.record(name, parent=parent, start_s=t0,
+                            duration_s=t1 - t0, wait_kind=kind, **tags)
+
+    def _finish_batch(self, reqs, results, error, round_t0=None,
+                      round_t1=None):
+        for req in reqs:
+            # queue wait: enqueue → batch cut; round wait: the shared
+            # consensus round this request rode (overlaps its batch-mates)
+            self._record_wait(req.span, "wait.group_commit_queue",
+                              "group_commit.queue", req.t_enq, round_t0)
+            self._record_wait(req.span, "wait.group_commit_round",
+                              "group_commit.round", round_t0, round_t1)
         for i, req in enumerate(reqs):
             if error is not None:
                 req.span.set_tag("error",
@@ -253,7 +277,12 @@ class GroupCommitter:
                     if self._pending.get(ref) == req.tx_id:
                         del self._pending[ref]
             deferred, self._deferred = self._deferred, []
-        for refs, tx_id, caller, trace_ctx, fut in deferred:
+        now = _time.time()
+        for refs, tx_id, caller, trace_ctx, fut, t_defer in deferred:
+            # defer wait: parked behind a pending-overlap blocker until
+            # this batch's completion re-screened it
+            self._record_wait(trace_ctx, "wait.group_commit_defer",
+                              "group_commit.defer", t_defer, now)
             self._admit(refs, tx_id, caller, trace_ctx, fut)
 
     # -- lifecycle -----------------------------------------------------------
@@ -284,7 +313,7 @@ class GroupCommitter:
             self._closed = True
             leftovers = self._queue + [
                 _Req(refs, tx_id, caller, ctx, fut, None)
-                for refs, tx_id, caller, ctx, fut in self._deferred]
+                for refs, tx_id, caller, ctx, fut, _t in self._deferred]
             self._queue = []
             self._deferred = []
             self._pending.clear()

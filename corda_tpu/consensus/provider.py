@@ -49,11 +49,25 @@ def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
                 raise _LeaderUnknown(str(e)) from e
             raise
 
+    def _sleep_traced(delay: float) -> None:
+        # wait-state span over the leaderless backoff: the retry sleep is
+        # commit-path dead time, so it rides the transaction's trace with
+        # a wait_kind instead of vanishing into retry_call (critpath.py
+        # charges it to the raft.leaderless blame component)
+        t0 = _time.time()
+        _time.sleep(delay)
+        if trace_ctx is not None:
+            from ..observability import get_tracer
+            get_tracer().record(
+                "wait.raft_leaderless", parent=trace_ctx, start_s=t0,
+                duration_s=_time.time() - t0,
+                wait_kind="raft.leaderless", site=site)
+
     return retry.retry_call(
         lambda: _submit(trace_ctx), site=site,
         policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5, max_attempts=6,
                                  deadline_s=timeout_s),
-        retry_on=(_LeaderUnknown,))
+        retry_on=(_LeaderUnknown,), sleep=_sleep_traced)
 
 
 def consensus_commit(backend, states, tx_id, caller: str,

@@ -125,9 +125,15 @@ class AwaitFuture:
     On checkpoint replay the producer is RE-EXECUTED, so it must be
     idempotent — the group-commit path qualifies: re-submitting a
     committed transaction's refs is absorbed by find_conflicts' same-tx
-    rule."""
+    rule.
+
+    ``purpose`` names what the flow is waiting FOR — it becomes the
+    ``wait_kind`` tag on the park's wait-state span, so the critical-path
+    extractor can attribute the parked time to a component instead of an
+    anonymous future."""
 
     producer: Callable[[], Any]
+    purpose: str = "future"
 
 
 @dataclass(frozen=True)

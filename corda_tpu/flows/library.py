@@ -124,7 +124,7 @@ class NotaryServiceFlow(FlowLogic):
                 trace_ctx = getattr(self.state_machine, "trace_ctx", None)
                 yield AwaitFuture(lambda: self.service.commit_async(
                     stx.inputs, stx.id, str(self.peer.name),
-                    trace_ctx=trace_ctx))
+                    trace_ctx=trace_ctx), purpose="notary.commit")
             elif getattr(self.service, "supports_trace_ctx", False):
                 self.service.commit(
                     stx.inputs, stx.id, str(self.peer.name),

@@ -320,6 +320,15 @@ class CordaRPCOps:
         log = getattr(self.hub.verifier_service, "request_log", None)
         return log.snapshot(limit=limit) if log is not None else {}
 
+    def critpath_report(self, top_k: int = 10) -> dict:
+        """Tail forensics for /debug/critpath: critical-path blame
+        decomposition + top-K slowest transactions with annotated
+        blocking chains, over every stitched trace currently in the
+        tracer ring (observability/critpath.py). Cheap-empty when
+        tracing is off."""
+        from ..observability import critpath, get_tracer
+        return critpath.critpath_report(get_tracer().traces(), top_k=top_k)
+
     def vault_feed(self, state_type: type | None = None) -> DataFeed:
         def subscribe(cb):
             self.hub.vault.add_update_observer(cb)

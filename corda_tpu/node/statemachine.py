@@ -111,6 +111,9 @@ class FlowStateMachine:
         # _finalize); trace_ctx rides into verifier submits and P2P sends
         self.trace_span = None
         self.trace_ctx = None
+        # wall-clock stamp of the current external park (Verify /
+        # AwaitFuture) — the wait-state span's start once the flow resumes
+        self.park_t0 = None
 
     @property
     def current_group(self) -> tuple[int, str]:
@@ -169,6 +172,20 @@ class StateMachineManager:
     def awaiting_external(self) -> int:
         """Flows parked on an off-node-thread future (e.g. Verify)."""
         return self._awaiting_external
+
+    def _record_wait(self, fsm: FlowStateMachine, name: str, kind: str,
+                     t0, **tags) -> None:
+        """Retroactive wait-state span: the time a flow spent parked at a
+        commit-path queue, recorded under the flow's root span once the
+        wait resolves. ``wait_kind`` makes "time not doing work" first-
+        class in the trace tree — observability/critpath.py attributes it
+        to a blame component instead of leaving an unexplained gap."""
+        if t0 is None or fsm.trace_ctx is None:
+            return
+        dur = _time.time() - t0
+        if dur > 0.0:
+            get_tracer().record(name, parent=fsm.trace_ctx, start_s=t0,
+                                duration_s=dur, wait_kind=kind, **tags)
 
     def _post_external(self, fn) -> None:
         """Thread-safe: queue a completion for the node thread."""
@@ -479,6 +496,7 @@ class StateMachineManager:
             check_sufficient_signatures=request.check_sufficient_signatures,
             **kwargs)
         self._awaiting_external += 1
+        fsm.park_t0 = _time.time()
         fut.add_done_callback(
             lambda f: self._post_external(
                 lambda: self._on_verify_done(fsm, f, request)))
@@ -495,6 +513,8 @@ class StateMachineManager:
             # future completion (double-invoked callback, flow already
             # resumed by another path) must not resume at the wrong yield.
             return
+        self._record_wait(fsm, "wait.verify_park", "verify.park",
+                          fsm.park_t0)
         err = fut.exception()
         if err is None:
             fsm.response_log.append(("value", None))
@@ -534,7 +554,8 @@ class StateMachineManager:
         # ONE external-wait slot for the whole wave: the flow resumes once,
         # when the slowest member resolves
         self._awaiting_external += 1
-        state = {"remaining": len(futs), "errors": {}}
+        state = {"remaining": len(futs), "errors": {},
+                 "n": len(futs), "t0": _time.time()}
         for i, fut in enumerate(futs):
             fut.add_done_callback(
                 lambda f, i=i: self._post_external(
@@ -558,6 +579,8 @@ class StateMachineManager:
             return
         if fsm.parked_on is not request:
             return
+        self._record_wait(fsm, "wait.verify_gather", "verify.gather",
+                          state["t0"], wave=state["n"])
         if state["errors"]:
             first = state["errors"][min(state["errors"])]
             fsm.response_log.append(("error", _error_payload(first)))
@@ -581,6 +604,7 @@ class StateMachineManager:
                 return self._log(fsm, ("value", fut.result()))
             return self._log(fsm, ("error", _error_payload(err)))
         self._awaiting_external += 1
+        fsm.park_t0 = _time.time()
         fut.add_done_callback(
             lambda f: self._post_external(
                 lambda: self._on_await_done(fsm, f, request)))
@@ -594,6 +618,9 @@ class StateMachineManager:
             return
         if fsm.parked_on is not request:
             return
+        self._record_wait(fsm, "wait.await_future",
+                          getattr(request, "purpose", "future"),
+                          fsm.park_t0)
         err = fut.exception()
         if err is None:
             fsm.response_log.append(("value", fut.result()))
@@ -972,7 +999,7 @@ class FlowScheduler:
     def __init__(self, smm: StateMachineManager, max_concurrent: int = 8):
         self.smm = smm
         self.max_concurrent = max_concurrent
-        self._waiting: list = []      # (flow factory, proxy future)
+        self._waiting: list = []      # (flow factory, proxy, submit wall ts)
         self._in_flight = 0
         self.high_water = 0           # max concurrent in-flight observed
         self.launched = 0
@@ -989,13 +1016,13 @@ class FlowScheduler:
         """Queue a flow for launch; returns a Future mirroring the flow's
         result_future (result or exception)."""
         proxy: Future = Future()
-        self._waiting.append((flow_factory, proxy))
+        self._waiting.append((flow_factory, proxy, _time.time()))
         self._pump()
         return proxy
 
     def _pump(self) -> None:
         while self._waiting and self._in_flight < self.max_concurrent:
-            factory, proxy = self._waiting.pop(0)
+            factory, proxy, t_sub = self._waiting.pop(0)
             self._in_flight += 1
             self.launched += 1
             if self._in_flight > self.high_water:
@@ -1006,6 +1033,12 @@ class FlowScheduler:
                 self._in_flight -= 1
                 proxy.set_exception(e)
                 continue
+            # admission wait: submit-to-launch time spent in _waiting. The
+            # flow's root span only exists from launch, so the wait span
+            # is recorded retroactively, starting BEFORE its parent — the
+            # critical-path extractor prepends it to the blocking chain.
+            self.smm._record_wait(fsm, "wait.scheduler_admission",
+                                  "scheduler.admission", t_sub)
             fsm.result_future.add_done_callback(
                 lambda f, proxy=proxy: self._on_done(f, proxy))
 

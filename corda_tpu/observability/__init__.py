@@ -21,10 +21,16 @@ Three pieces (docs/OBSERVABILITY.md):
   burn-rate alerts (surfaced on /readyz as ``degraded.slo``).
 - ledger_harness.py — open-loop end-to-end commit-path load scenario
   (bench.py --ledger / tools/scenario.py).
+- critpath.py — tail forensics: critical-path (blocking chain) extraction
+  over stitched span trees, wait_kind blame attribution, the
+  ``ledger_critpath_*`` artifact fields and /debug/critpath payload.
 
 The Histogram metric type itself lives in utils/metrics.py with the rest
 of the registry.
 """
+from .critpath import (COMPONENTS, WAIT_KINDS, aggregate_critpaths,
+                       component_of, critical_path, critpath_report,
+                       flow_kind, ledger_critpath_fields)
 from .federation import FleetMetricsFederation
 from .lifecycle import RequestLog
 from .profiling import (KernelProfiler, OverlapTracker, get_profiler,
@@ -39,11 +45,13 @@ from .tracing import (NOOP_SPAN, NOOP_TRACER, NoopTracer, Span, SpanContext,
                       make_span_dict, set_tracer)
 
 __all__ = [
-    "DEFAULT_OBJECTIVES", "FleetMetricsFederation", "KernelProfiler",
-    "LEDGER_STAGE_METRICS", "NOOP_SPAN", "NOOP_TRACER", "NoopTracer",
-    "OverlapTracker", "RequestLog", "SLObjective", "SLOTracker", "Span",
-    "SpanContext", "SpanRing", "STAGE_METRICS", "Tracer", "disable_tracing",
-    "enable_tracing", "get_profiler", "get_tracer", "jlog",
-    "ledger_stage_percentiles", "make_span_dict", "set_profiler",
-    "set_tracer", "stage_percentiles",
+    "COMPONENTS", "DEFAULT_OBJECTIVES", "FleetMetricsFederation",
+    "KernelProfiler", "LEDGER_STAGE_METRICS", "NOOP_SPAN", "NOOP_TRACER",
+    "NoopTracer", "OverlapTracker", "RequestLog", "SLObjective",
+    "SLOTracker", "Span", "SpanContext", "SpanRing", "STAGE_METRICS",
+    "Tracer", "WAIT_KINDS", "aggregate_critpaths", "component_of",
+    "critical_path", "critpath_report", "disable_tracing",
+    "enable_tracing", "flow_kind", "get_profiler", "get_tracer", "jlog",
+    "ledger_critpath_fields", "ledger_stage_percentiles", "make_span_dict",
+    "set_profiler", "set_tracer", "stage_percentiles",
 ]

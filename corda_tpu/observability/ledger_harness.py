@@ -57,6 +57,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from .critpath import ledger_critpath_fields
 from .slo import DEFAULT_OBJECTIVES, SLOTracker
 from .stages import group_commit_fields, ledger_stage_percentiles
 
@@ -694,6 +695,12 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
                     _percentile(flow_k, qv) * 1000, 3)
         report.update(ledger_stage_percentiles(snapshot))
         report.update(group_commit_fields(snapshot))
+        # tail forensics: per-flow-class critical-path blame vectors over
+        # the stitched span trees (critpath.py). Each p50/p99 vector is
+        # the decomposition of that quantile's transaction, so its
+        # components sum to that transaction's e2e — the conservation
+        # property bench.py probes and benchguard locks.
+        report.update(ledger_critpath_fields(traces))
         # the ISSUE's named headline for the double-spend check, duplicated
         # from the stage percentile so benchguard can floor it directly
         report["notary_uniqueness_p99_ms"] = report.get(

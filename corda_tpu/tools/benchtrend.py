@@ -39,7 +39,11 @@ FAMILIES = {
     "ledger": (benchguard.ledger_trajectory_paths,
                ("committed_tx_per_sec", "e2e_ms_p99",
                 "notary_uniqueness_p99_ms", "slo_error_budget_pct",
-                "exactly_once_ok")),
+                "exactly_once_ok",
+                # tail forensics (rounds before r03 render "-")
+                "ledger_critpath_dominant_issue",
+                "ledger_critpath_dominant_pay",
+                "ledger_critpath_dominant_settle")),
 }
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")

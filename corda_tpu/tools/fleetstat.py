@@ -61,10 +61,13 @@ def _cell(value, default):
     return value
 
 
-def render(fleet: dict, metrics: dict) -> str:
-    """One screenful: fleet header + a row per worker. Pure function of
-    the two JSON payloads — tolerates empty and malformed ones (a worker
-    that crashed mid-report can leave non-dict entries behind)."""
+def render(fleet: dict, metrics: dict, critpath: dict | None = None) -> str:
+    """One screenful: fleet header + a row per worker, plus (when the
+    node answers /debug/critpath) one tail-forensics line per flow class:
+    the dominant blame component and its p50 share. Pure function of the
+    JSON payloads — tolerates empty and malformed ones (a worker that
+    crashed mid-report can leave non-dict entries behind; a node without
+    tracing answers critpath with zero traces)."""
     if not isinstance(fleet, dict):
         fleet = {}
     if not isinstance(metrics, dict):
@@ -129,6 +132,26 @@ def render(fleet: dict, metrics: dict) -> str:
                     + (f"({a.get('step') or a.get('worker')})"
                        if (a.get('step') or a.get('worker')) else "")
                     for a in tail))
+    per_class = critpath.get("per_class") if isinstance(critpath, dict) \
+        else None
+    if isinstance(per_class, dict) and per_class:
+        parts = []
+        for kind in sorted(per_class):
+            c = per_class[kind]
+            if not isinstance(c, dict):
+                continue
+            blame = c.get("blame_p50")
+            dom = c.get("dominant")
+            share = blame.get(dom) if isinstance(blame, dict) \
+                and isinstance(dom, str) else None
+            e2e = c.get("e2e_ms_p50")
+            pct = (f" {100 * share / e2e:.0f}%"
+                   if isinstance(share, (int, float))
+                   and isinstance(e2e, (int, float))
+                   and not isinstance(e2e, bool) and e2e > 0 else "")
+            parts.append(f"{kind}={_cell(dom, '?')}{pct}")
+        if parts:
+            lines.append("critpath blame(p50): " + "  ".join(parts))
     return "\n".join(lines)
 
 
@@ -150,7 +173,13 @@ def main(argv=None) -> int:
             print(f"fleetstat: cannot reach {args.url}: {e}",
                   file=sys.stderr)
             return 1
-        screen = render(fleet, metrics)
+        try:
+            # optional surface: older nodes (or tracing off) just lose
+            # the blame line, not the whole screen
+            critpath = fetch(args.url, "/debug/critpath?top_k=1")
+        except Exception:
+            critpath = None
+        screen = render(fleet, metrics, critpath)
         if args.once:
             print(screen)
             return 0

@@ -16,6 +16,7 @@ app APIs and static content. Endpoints:
     GET  /readyz                readiness checks (200 ready / 503 not)
     GET  /debug/profile         kernel flight-recorder snapshot
     GET  /debug/requests        per-request lifecycle timelines (fleet)
+    GET  /debug/critpath        critical-path blame + top-K slow traces
     GET  /api/fleet             fleet membership + per-worker load
     GET  /traces                span ring (tracing enabled: spans by trace)
     POST /api/flows/<FlowName>  body: JSON list of args -> run id / result
@@ -38,6 +39,18 @@ def _escape_label(value: str) -> str:
     """Prometheus label-value escaping: backslash, double quote, newline."""
     return (str(value).replace("\\", "\\\\").replace('"', '\\"')
             .replace("\n", "\\n"))
+
+
+def _trace_duration_ms(spans) -> float:
+    """A trace's headline duration for the /traces min_duration_ms filter:
+    its longest single span (the root covers the whole tree on the commit
+    path). Malformed spans contribute 0 — the filter never raises."""
+    best = 0.0
+    for s in spans if isinstance(spans, (list, tuple)) else ():
+        d = s.get("duration_s") if isinstance(s, dict) else None
+        if isinstance(d, (int, float)) and not isinstance(d, bool):
+            best = max(best, float(d))
+    return best * 1000.0
 
 
 def _escape_help(text: str) -> str:
@@ -298,6 +311,16 @@ class NodeWebServer:
                     except Exception as e:
                         self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
+                if (self.path == "/debug/critpath"
+                        or self.path.startswith("/debug/critpath?")):
+                    try:
+                        self._reply(200, server.handle_debug_critpath(
+                            self.path))
+                    except ValueError as e:
+                        self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                    except Exception as e:
+                        self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
                 if self.path == "/traces" or self.path.startswith("/traces?"):
                     try:
                         ctype, body = server.handle_traces(self.path)
@@ -393,20 +416,43 @@ class NodeWebServer:
             return {"requests": {}}
         return {"requests": timelines_fn(limit)}
 
+    def handle_debug_critpath(self, path: str) -> dict:
+        """GET /debug/critpath — tail forensics: per-flow-class blame
+        decomposition and the top-K slowest transactions with annotated
+        blocking chains (observability/critpath.py). ``top_k`` caps the
+        slow-transaction list. Served from the ops object when it exposes
+        ``critpath_report`` (the node RPC surface), straight off the
+        process tracer otherwise; always well-formed, empty when tracing
+        is off."""
+        from urllib.parse import parse_qs, urlsplit
+        q = parse_qs(urlsplit(path).query)
+        top_raw = q.get("top_k", [None])[0]
+        top_k = int(top_raw) if top_raw is not None else 10
+        report_fn = getattr(self.ops, "critpath_report", None)
+        if report_fn is not None:
+            return report_fn(top_k)
+        from ..observability import critpath, get_tracer
+        return critpath.critpath_report(get_tracer().traces(), top_k=top_k)
+
     def handle_traces(self, path: str) -> tuple[str, bytes]:
         """GET /traces — spans from the live tracer's ring buffer.
 
         Query params: ``trace_id`` filters to one trace; ``limit`` caps
-        returned spans (newest kept); ``format=jsonl`` streams one span per
-        line (the export format) instead of the grouped-JSON default. With
-        tracing disabled (the no-op default) the answer is well-formed and
-        empty — scraping is always safe."""
+        returned spans (newest kept); ``min_duration_ms`` keeps only
+        traces whose longest span is at least that long (the pull handle
+        for a slow transaction surfaced by /debug/critpath's top-K);
+        ``format=jsonl`` streams one span per line (the export format)
+        instead of the grouped-JSON default. With tracing disabled (the
+        no-op default) the answer is well-formed and empty — scraping is
+        always safe."""
         from urllib.parse import parse_qs, urlsplit
         from ..observability import get_tracer
         q = parse_qs(urlsplit(path).query)
         trace_id = q.get("trace_id", [None])[0]
         limit_raw = q.get("limit", [None])[0]
         limit = int(limit_raw) if limit_raw is not None else None
+        min_raw = q.get("min_duration_ms", [None])[0]
+        min_ms = float(min_raw) if min_raw is not None else None
         fmt = q.get("format", ["json"])[0]
         tracer = get_tracer()
         if fmt == "jsonl":
@@ -421,8 +467,11 @@ class NodeWebServer:
             payload = {"enabled": tracer.enabled, "trace_id": trace_id,
                        "spans": spans}
         else:
-            payload = {"enabled": tracer.enabled,
-                       "traces": tracer.traces(limit_spans=limit)}
+            traces = tracer.traces(limit_spans=limit)
+            if min_ms is not None:
+                traces = {tid: spans for tid, spans in traces.items()
+                          if _trace_duration_ms(spans) >= min_ms}
+            payload = {"enabled": tracer.enabled, "traces": traces}
         return "application/json", json.dumps(payload, indent=2).encode()
 
     def handle_post(self, path: str, args):

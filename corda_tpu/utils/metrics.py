@@ -138,8 +138,13 @@ class Histogram:
         return False
 
     def quantile(self, q: float) -> float:
-        """Upper bound of the bucket holding the q-quantile sample (0 when
-        empty), clamped to the observed maximum."""
+        """q-quantile estimate, linearly interpolated WITHIN the bucket
+        holding the target sample (0 when empty), clamped to the observed
+        maximum. Snapping to the bucket's upper edge — the previous
+        behaviour — overstates tails by up to one quarter-decade (×1.78)
+        whenever the target rank lands early in a log bucket; the rank
+        fraction positions the estimate between the bucket's edges
+        instead."""
         with self._lock:
             counts = list(self._counts)
             count = self.count
@@ -151,9 +156,12 @@ class Histogram:
         for i, c in enumerate(counts):
             cum += c
             if cum >= target:
-                if i < len(self.BOUNDS):
-                    return min(self.BOUNDS[i], max_v)
-                return max_v
+                if i >= len(self.BOUNDS):
+                    return max_v     # overflow bucket: max is all we know
+                lo = self.BOUNDS[i - 1] if i > 0 else 0.0
+                hi = self.BOUNDS[i]
+                frac = (target - (cum - c)) / c
+                return min(lo + frac * (hi - lo), max_v)
         return max_v
 
     def snapshot_fields(self) -> dict:

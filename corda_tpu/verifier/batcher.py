@@ -550,12 +550,28 @@ class SignatureBatcher:
                 # (interactive is always admitted — its whole point is
                 # bounded latency under bulk pressure). The planner's
                 # drains notify this wait as depth comes down.
+                blocked_t0 = _time.time()
+                blocked = False
                 while (not self._closed
                        and sum(len(q.bulk) for q in self._queues.values())
                        >= self.max_pending):
+                    blocked = True
                     self._lock.wait(timeout=0.1)
                 if self._closed:
                     raise RuntimeError("SignatureBatcher is closed")
+                if blocked:
+                    # wait-state span: admission blocked at the bulk cap.
+                    # One span per submission, parented to the (shared)
+                    # caller context stamped on the wave's pendings.
+                    ctx = next((p.ctx for p in pendings
+                                if p.ctx is not None), None)
+                    if ctx is not None:
+                        now = _time.time()
+                        get_tracer().record(
+                            "wait.verifier_admission", parent=ctx,
+                            start_s=blocked_t0, duration_s=now - blocked_t0,
+                            wait_kind="verifier.admission",
+                            n_sigs=len(pendings))
             now = _time.monotonic()
             for bucket, ps in routed.items():
                 self._queues[bucket].add(latency_class, ps, now)

@@ -234,6 +234,24 @@ def _ledger(**over):
         "flow_ms_p99_pay": 600.0,
         "flow_ms_p50_settle": 250.0, "flow_ms_p90_settle": 500.0,
         "flow_ms_p99_settle": 700.0,
+        # tail-forensics critical-path fields (ISSUE 14): each p50 blame
+        # vector sums exactly to its class's critpath e2e (conservation)
+        "ledger_critpath_traces": 183,
+        "ledger_critpath_top": [],
+        "ledger_critpath_blame_p50_issue": {"flow.compute": 60.0,
+                                            "raft.commit": 40.0},
+        "ledger_critpath_blame_p99_issue": {"raft.commit": 300.0},
+        "ledger_critpath_e2e_p50_ms_issue": 100.0,
+        "ledger_critpath_dominant_issue": "flow.compute",
+        "ledger_critpath_blame_p50_pay": {"scheduler.wait": 250.0,
+                                          "notary.batch_wait": 150.0},
+        "ledger_critpath_blame_p99_pay": {"scheduler.wait": 1200.0},
+        "ledger_critpath_e2e_p50_ms_pay": 400.0,
+        "ledger_critpath_dominant_pay": "scheduler.wait",
+        "ledger_critpath_blame_p50_settle": {"notary.batch_wait": 500.0},
+        "ledger_critpath_blame_p99_settle": {"notary.batch_wait": 1500.0},
+        "ledger_critpath_e2e_p50_ms_settle": 500.0,
+        "ledger_critpath_dominant_settle": "notary.batch_wait",
     }
     base.update(over)
     return base
@@ -261,8 +279,8 @@ def test_ledger_regression_fails_against_trajectory(tmp_path):
     slow = _ledger(committed_tx_per_sec=10.0 * (1 - 0.16))
     problems = benchguard.guard_ledger(slow, [str(good)])
     assert any("committed_tx_per_sec" in p for p in problems)
-    # uniqueness-tail blowup breaches the ceiling
-    tail = _ledger(notary_uniqueness_p99_ms=100.0 * 1.6)
+    # uniqueness-tail blowup breaches the ceiling (tolerance 1.0 → 2x best)
+    tail = _ledger(notary_uniqueness_p99_ms=100.0 * 2.1)
     problems = benchguard.guard_ledger(tail, [str(good)])
     assert any("notary_uniqueness_p99_ms" in p for p in problems)
     # within tolerance passes
@@ -296,6 +314,27 @@ def test_ledger_smoke_gets_schema_check_only(tmp_path):
     fast.write_text(json.dumps(_ledger(committed_tx_per_sec=1000.0)))
     smoke = _ledger(committed_tx_per_sec=0.5, smoke=True)
     assert benchguard.guard_ledger(smoke, [str(fast)]) == []
+
+
+def test_ledger_critpath_blame_conservation_probe(tmp_path):
+    # the helper's vectors sum exactly to their e2e: clean
+    assert benchguard.ledger_critpath_violations(_ledger()) == []
+    # a vector that lost 20% of its e2e (dropped spans) is INVALID
+    broken = _ledger(
+        ledger_critpath_blame_p50_pay={"scheduler.wait": 320.0})
+    problems = benchguard.ledger_critpath_violations(broken)
+    assert len(problems) == 1 and "pay" in problems[0]
+    # an empty class (never ran in this round) is skipped, not a breach
+    assert benchguard.ledger_critpath_violations(
+        _ledger(ledger_critpath_blame_p50_settle={},
+                ledger_critpath_e2e_p50_ms_settle=0.0)) == []
+    # non-smoke guard_ledger enforces it; smoke stays schema-only
+    good = tmp_path / "LEDGER_r01.json"
+    good.write_text(json.dumps(_ledger()))
+    problems = benchguard.guard_ledger(broken, [str(good)])
+    assert any("lost spans" in p for p in problems)
+    assert benchguard.guard_ledger(dict(broken, smoke=True),
+                                   [str(good)]) == []
 
 
 def test_ledger_real_artifact_passes_self_replay():

@@ -44,6 +44,25 @@ def test_render_table_empty():
     assert "(no artifacts)" in render_table("bench", [], ("value",))
 
 
+def test_ledger_family_carries_critpath_columns():
+    metrics = FAMILIES["ledger"][1]
+    for kind in ("issue", "pay", "settle"):
+        assert f"ledger_critpath_dominant_{kind}" in metrics
+    # a pre-critpath round renders "-" in the new columns, a new round
+    # shows the dominant blame component — side by side in one table
+    rounds = [
+        ("r02", {"committed_tx_per_sec": 19.2}),
+        ("r03", {"committed_tx_per_sec": 21.0,
+                 "ledger_critpath_dominant_issue": "flow.compute",
+                 "ledger_critpath_dominant_pay": "scheduler.wait",
+                 "ledger_critpath_dominant_settle": "notary.batch_wait"}),
+    ]
+    out = render_table("ledger", rounds, metrics)
+    old = next(l for l in out.splitlines() if l.startswith("r02"))
+    new = next(l for l in out.splitlines() if l.startswith("r03"))
+    assert "-" in old.split() and "scheduler.wait" in new
+
+
 def test_load_rounds_orders_and_unwraps(tmp_path):
     # BENCH artifacts wrap the metrics in "parsed"; LEDGER ones are flat
     (tmp_path / "BENCH_r02.json").write_text(json.dumps(

@@ -52,6 +52,32 @@ def test_render_survives_empty_payloads():
     assert "no workers attached" in screen
 
 
+def test_render_critpath_blame_line():
+    critpath = {"per_class": {
+        "pay": {"n": 4, "e2e_ms_p50": 400.0, "e2e_ms_p99": 900.0,
+                "dominant": "scheduler.wait",
+                "blame_p50": {"scheduler.wait": 300.0,
+                              "flow.compute": 100.0}},
+        "issue": {"n": 2, "e2e_ms_p50": 100.0, "e2e_ms_p99": 120.0,
+                  "dominant": "flow.compute",
+                  "blame_p50": {"flow.compute": 100.0}},
+    }}
+    screen = render(FLEET, METRICS, critpath)
+    line = next(l for l in screen.splitlines()
+                if l.startswith("critpath blame(p50):"))
+    assert "pay=scheduler.wait 75%" in line
+    assert "issue=flow.compute 100%" in line
+    # no critpath payload (old node / tracing off): line simply absent
+    assert "critpath" not in render(FLEET, METRICS)
+    assert "critpath" not in render(FLEET, METRICS, {"traces": 0,
+                                                     "per_class": {}})
+    # malformed payloads never break the screen
+    for junk in ("oops", {"per_class": "x"}, {"per_class": {"pay": 3}},
+                 {"per_class": {"pay": {"dominant": None,
+                                        "blame_p50": "x"}}}):
+        assert render(FLEET, METRICS, junk)
+
+
 def test_render_survives_non_dict_payloads():
     # a webserver mid-restart can serve error strings / partial bodies
     for fleet, metrics in (

@@ -79,6 +79,26 @@ def test_smoke_scenario_stitches_one_commit_path_trace():
     for stage in ("flow_run", "tx_verify", "notary_uniqueness",
                   "raft_commit", "vault_update"):
         assert report[f"ledger_stage_{stage}_ms_p99"] >= 0.0
+    # tail forensics (ISSUE 14): the critical-path extractor decomposed
+    # the stitched traces and every emitted p50 blame vector conserves
+    # its class's e2e — the property bench.py turns into BENCH INVALID
+    assert report["ledger_critpath_traces"] >= 1
+    decomposed = 0
+    for kind in ("issue", "pay", "settle"):
+        blame = report[f"ledger_critpath_blame_p50_{kind}"]
+        e2e = report[f"ledger_critpath_e2e_p50_ms_{kind}"]
+        if not blame:
+            continue
+        decomposed += 1
+        assert e2e > 0.0
+        assert abs(sum(blame.values()) - e2e) <= 0.10 * e2e, (kind, blame,
+                                                              e2e)
+        assert report[f"ledger_critpath_dominant_{kind}"] in blame
+    assert decomposed >= 1, "no flow class got a blame vector"
+    # the slow-transaction report is annotated with its blocking chain
+    assert report["ledger_critpath_top"], report["ledger_critpath_traces"]
+    top = report["ledger_critpath_top"][0]
+    assert top["segments"] and top["e2e_ms"] > 0.0
 
 
 @pytest.mark.ledger

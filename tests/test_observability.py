@@ -57,6 +57,27 @@ def test_histogram_empty_and_single_sample():
     assert h.quantile(0.99) == 0.25
 
 
+def test_histogram_interpolates_within_bucket_boundaries():
+    """Regression pin: quantile() used to snap to the bucket's UPPER edge,
+    so 95 identical 2.0s samples reported p50 = 3.162 (the quarter-decade
+    bound above 2.0) — a +58% tail overstatement at every bucket boundary.
+    Linear interpolation inside the bucket keeps the estimate near the
+    mass."""
+    h = Histogram()
+    for _ in range(95):
+        h.update(2.0)
+    for _ in range(5):
+        h.update(1000.0)
+    p50 = h.quantile(0.50)
+    # 2.0 lives in bucket (1.778, 3.162]; rank 50 of the 95 samples there
+    # interpolates to ~2.51 — strictly inside, never the 3.162 edge
+    assert 1.778 < p50 < 3.0
+    assert p50 == pytest.approx(2.507, rel=0.01)
+    # the tail quantile still never exceeds the observed max
+    assert 500.0 < h.quantile(0.99) <= 1000.0
+    assert h.quantile(0.50) <= h.quantile(0.90) <= h.quantile(0.99)
+
+
 def test_histogram_in_registry_snapshot_and_prometheus():
     from corda_tpu.tools.webserver import prometheus_text
     reg = MetricRegistry()

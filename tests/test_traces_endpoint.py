@@ -2,6 +2,7 @@
 verified through TransactionVerifierService produces ONE trace whose spans
 cover submit → batch flush → dispatch → resolve, retrievable over HTTP."""
 import json
+import urllib.error
 import urllib.request
 
 import pytest
@@ -166,3 +167,66 @@ def test_traces_endpoint_stitches_cross_process_fleet_trace(web):
     assert dispatch["parent_id"] == submit["span_id"]
     assert dispatch["tags"]["worker"] == "w1"
     worker.stop()
+
+
+def test_traces_endpoint_min_duration_filter(web):
+    """?min_duration_ms= keeps only traces whose longest span clears the
+    threshold — the tail-forensics entry point (find the slow ones)."""
+    server = web
+    tracer = enable_tracing()
+    slow = tracer.record("flow.run", duration_s=2.0)
+    tracer.record("tx.verify", parent=slow, duration_s=0.5)
+    tracer.record("flow.run", duration_s=0.001)   # separate fast trace
+    out = _get_json(server, "/traces")
+    assert len(out["traces"]) == 2
+    out = _get_json(server, "/traces?min_duration_ms=1000")
+    assert len(out["traces"]) == 1
+    (spans,) = out["traces"].values()
+    assert {s["name"] for s in spans} == {"flow.run", "tx.verify"}
+    # threshold above every trace: empty, not an error
+    assert _get_json(server, "/traces?min_duration_ms=60000")["traces"] == {}
+    # composes with trace_id (filtered single-trace view unaffected)
+    assert _get_json(
+        server,
+        f"/traces?trace_id={slow.trace_id}&min_duration_ms=60000")["spans"]
+    # malformed value is a 400, not a 500
+    try:
+        _get_json(server, "/traces?min_duration_ms=soon")
+        assert False, "expected HTTP 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_debug_critpath_endpoint(web):
+    """/debug/critpath returns the blame decomposition of live traces:
+    per-class vectors that sum to the class e2e, and a top-K list of
+    slowest transactions with annotated blocking chains."""
+    server = web
+    # tracing off: well-formed empty report
+    out = _get_json(server, "/debug/critpath")
+    assert out["traces"] == 0 and out["top"] == []
+    tracer = enable_tracing()
+    # synthetic commit path: flow.run with a verify child and a notary
+    # wait — the decomposition must cover all 4s
+    root = tracer.record("flow.run", start_s=100.0, duration_s=4.0,
+                         flow_type="corda_tpu.finance.cash.CashPaymentFlow")
+    tracer.record("tx.verify", parent=root, start_s=100.5, duration_s=1.0)
+    tracer.record("wait.await_future", parent=root, start_s=101.5,
+                  duration_s=2.0, wait_kind="notary.commit")
+    out = _get_json(server, "/debug/critpath?top_k=3")
+    assert out["traces"] == 1
+    assert out["per_class"]["pay"]["n"] == 1
+    blame = out["per_class"]["pay"]["blame_p50"]
+    assert abs(sum(blame.values()) - 4000.0) < 1.0   # conservation
+    assert blame["verify"] == pytest.approx(1000.0)
+    assert blame["notary.batch_wait"] == pytest.approx(2000.0)
+    (top,) = out["top"]
+    assert top["e2e_ms"] == pytest.approx(4000.0)
+    kinds = [s["wait_kind"] for s in top["segments"]]
+    assert "notary.commit" in kinds
+    # bad top_k is a 400
+    try:
+        _get_json(server, "/debug/critpath?top_k=many")
+        assert False, "expected HTTP 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
