@@ -23,6 +23,15 @@ Admission is pre-screened on the leader:
   it: if the blocker ultimately fails, the deferred request must still
   get its chance. Deferred requests are re-screened every time a batch
   completes.
+* **reservation defer** — a ref provisionally held by a cross-shard
+  2PC (``reserved_view``) is treated the same way: a reservation is
+  revocable, so the request parks instead of receiving a terminal
+  double-spend verdict for a state that may never be consumed. Because
+  the blocker resolves OUTSIDE this committer (the coordinator's
+  finalize/release rounds bypass it), the stall ticker re-screens the
+  deferred list whenever no batch completion is coming. A consensus
+  verdict whose conflicts are reservation-only arrives flagged
+  ``provisional`` and re-parks the same way.
 
 Batch cutting mirrors ``verifier.batcher.SignatureBatcher``: flush at
 ``max_batch`` depth, at the ``max_latency_s`` deadline from the first
@@ -72,20 +81,31 @@ class GroupCommitter:
     def __init__(self, backend, timeout_s: float = 30.0,
                  max_batch: int = 256, max_latency_s: float = 0.005,
                  stall_fraction: float = 0.2, metrics=None,
-                 applied_view=None, prescreen: bool = True,
-                 max_inflight_batches: int = 4):
+                 applied_view=None, reserved_view=None,
+                 prescreen: bool = True,
+                 max_inflight_batches: int = 4, label: str | None = None,
+                 attempt_timeout_s: float | None = None):
         from ..observability import get_tracer
         from ..utils.metrics import MetricRegistry
         self.backend = backend
         self.timeout_s = timeout_s
+        #: per-attempt bound on one consensus submit (provider.py): a
+        #: batch stranded on a deposed leader is abandoned + re-submitted
+        #: instead of serialising the whole pipeline behind timeout_s
+        self.attempt_timeout_s = attempt_timeout_s
         self.max_batch = max_batch
         self.max_latency_s = max_latency_s
         self.stall_fraction = stall_fraction
+        #: shard label ("s0"): tags this committer's spans and adds a
+        #: labeled per-shard committed meter next to the shared aggregate
+        #: ones (the federation `Family{worker="w0"}` naming convention).
+        self.label = label
         #: prescreen=False feeds conflicting pairs into the SAME batch so
         #: apply's first-wins-in-list-order verdict is what's under test
         #: (the chaos suite uses this knob); production leaves it on.
         self.prescreen = prescreen
         self._applied_view = applied_view
+        self._reserved_view = reserved_view
         self._tracer = get_tracer()
         self.metrics = metrics if metrics is not None else MetricRegistry()
         self._batch_size_hist = self.metrics.histogram(
@@ -96,11 +116,15 @@ class GroupCommitter:
         self._m_rejected = self.metrics.meter("GroupCommit.Rejected")
         self._m_prescreened = self.metrics.meter("GroupCommit.PreScreened")
         self._m_deferred = self.metrics.meter("GroupCommit.Deferred")
+        self._m_committed_shard = (
+            self.metrics.meter(f'GroupCommit.Committed{{shard="{label}"}}')
+            if label else None)
 
         self._lock = threading.Lock()
         self._queue: list[_Req] = []
         self._pending: dict = {}        # ref -> tx_id claimed by queue/flight
-        self._deferred: list = []       # (refs, tx_id, caller, ctx, future)
+        self._deferred: list = []       # (refs, tx_id, caller, ctx, fut, t)
+        self._inflight = 0              # batches submitted, not yet finished
         self._t_first = 0.0
         self._t_last = 0.0
         self._n_batches = 0
@@ -126,9 +150,14 @@ class GroupCommitter:
         return fut
 
     def _admit(self, refs, tx_id, caller, trace_ctx, fut,
-               raise_closed=False):
+               raise_closed=False, t_defer=None):
+        """Admission with prescreen. ``t_defer`` is set when this call is
+        a re-screen of a previously deferred request: the original park
+        time is preserved (one defer meter mark and one wait span per
+        deferred EPISODE, however many re-screen polls it takes)."""
         reject = None
         do_flush = False
+        now = _time.time()
         with self._lock:
             if self._closed:
                 if raise_closed:
@@ -142,25 +171,47 @@ class GroupCommitter:
                     conflicts = find_conflicts(applied, refs, tx_id)
                     if conflicts:
                         reject = UniquenessException(conflicts)
-                if reject is None and any(r in self._pending for r in refs):
+                blocked = False
+                if reject is None and self._reserved_view is not None:
+                    held = self._reserved_view()
+                    blocked = any(
+                        (h := held.get(r)) is not None
+                        and getattr(h, "consuming_tx", None) != tx_id
+                        for r in refs)
+                if reject is None and (
+                        blocked or any(r in self._pending for r in refs)):
+                    # Park, never terminal-reject: a pending overlap
+                    # resolves at batch completion, and a cross-shard
+                    # reservation is REVOCABLE — its holder may abort and
+                    # release, in which case this spend must still get
+                    # its chance (the ticker re-screens for resolutions
+                    # that happen outside this committer).
                     self._deferred.append(
-                        (refs, tx_id, caller, trace_ctx, fut, _time.time()))
-                    self._m_deferred.mark()
+                        (refs, tx_id, caller, trace_ctx, fut,
+                         now if t_defer is None else t_defer))
+                    if t_defer is None:
+                        self._m_deferred.mark()
                     return
             if reject is None:
+                tags = {"shard": self.label} if self.label else {}
                 span = self._tracer.span(
                     "raft.commit", parent=trace_ctx, n_states=len(refs),
-                    caller=caller, group_commit=True)
+                    caller=caller, group_commit=True, **tags)
                 for r in refs:
                     self._pending[r] = tx_id
-                now = _time.monotonic()
+                mono = _time.monotonic()
                 if not self._queue:
-                    self._t_first = now
-                self._t_last = now
+                    self._t_first = mono
+                self._t_last = mono
                 self._queue.append(
                     _Req(refs, tx_id, caller, trace_ctx, fut, span,
-                         t_enq=_time.time()))
+                         t_enq=now))
                 do_flush = len(self._queue) >= self.max_batch
+        if t_defer is not None:
+            # leaving the deferred state (enqueued or rejected): one wait
+            # span covering the whole parked interval
+            self._record_wait(trace_ctx, "wait.group_commit_defer",
+                              "group_commit.defer", t_defer, now)
         if reject is not None:
             self._m_prescreened.mark()
             fut.set_exception(reject)
@@ -172,6 +223,7 @@ class GroupCommitter:
     def _ticker(self):
         while not self._stop.wait(self._tick):
             reason = None
+            rescreen = None
             with self._lock:
                 if self._queue:
                     now = _time.monotonic()
@@ -180,8 +232,19 @@ class GroupCommitter:
                     elif now >= (self._t_last
                                  + self.max_latency_s * self.stall_fraction):
                         reason = "stalled"
+                elif self._deferred and self._inflight == 0:
+                    # nothing queued and no batch in flight: no batch
+                    # completion is coming to re-screen the deferred set,
+                    # and its blocker (a cross-shard reservation) resolves
+                    # OUTSIDE this committer — poll from the ticker so a
+                    # released ref's spender is never stranded
+                    rescreen, self._deferred = self._deferred, []
             if reason is not None:
                 self._flush(reason)
+            if rescreen:
+                for refs, tx_id, caller, trace_ctx, fut, t_defer in rescreen:
+                    self._admit(refs, tx_id, caller, trace_ctx, fut,
+                                t_defer=t_defer)
 
     def _flush(self, reason: str):
         with self._lock:
@@ -193,6 +256,7 @@ class GroupCommitter:
                 # restamp the deadline clock for the remainder
                 self._t_first = _time.monotonic()
             self._n_batches += 1
+            self._inflight += 1
         try:
             self._pool.submit(self._run_batch, reqs, reason)
         except RuntimeError:
@@ -204,9 +268,10 @@ class GroupCommitter:
         first_ctx = next(
             (r.trace_ctx for r in reqs if r.trace_ctx is not None), None)
         n_states = sum(len(r.refs) for r in reqs)
+        tags = {"shard": self.label} if self.label else {}
         sp = self._tracer.span("notary.batch_commit", parent=first_ctx,
                                n_txs=len(reqs), n_states=n_states,
-                               reason=reason)
+                               reason=reason, **tags)
         trace_id = getattr(sp.context() or first_ctx, "trace_id", None)
         self._batch_size_hist.update(float(len(reqs)), trace_id=trace_id)
         round_t0 = _time.time()
@@ -219,7 +284,8 @@ class GroupCommitter:
                 self.backend, ("put_all_batch", payload), self.timeout_s,
                 trace_ctx=sp.context() or first_ctx,
                 on_attempt=self._m_appends.mark,
-                site="raft.submit.group_commit")
+                site="raft.submit.group_commit",
+                attempt_timeout_s=self.attempt_timeout_s)
             results = out["results"]
         except BaseException as e:
             error = e
@@ -250,6 +316,7 @@ class GroupCommitter:
                               "group_commit.queue", req.t_enq, round_t0)
             self._record_wait(req.span, "wait.group_commit_round",
                               "group_commit.round", round_t0, round_t1)
+        provisional: list[_Req] = []
         for i, req in enumerate(reqs):
             if error is not None:
                 req.span.set_tag("error",
@@ -258,10 +325,21 @@ class GroupCommitter:
                 req.future.set_exception(error)
                 continue
             verdict = results[i]
+            if (self.prescreen and not verdict["committed"]
+                    and verdict.get("provisional")):
+                # every conflict is a revocable cross-shard reservation,
+                # not a consumed entry: re-park instead of handing the
+                # client a terminal double-spend for an unspent state
+                req.span.set_tag("deferred_reservation", True)
+                req.span.finish()
+                provisional.append(req)
+                continue
             req.span.set_tag("committed", verdict["committed"])
             req.span.finish()
             if verdict["committed"]:
                 self._m_committed.mark()
+                if self._m_committed_shard is not None:
+                    self._m_committed_shard.mark()
                 req.future.set_result(None)
             else:
                 self._m_rejected.mark()
@@ -277,13 +355,12 @@ class GroupCommitter:
                     if self._pending.get(ref) == req.tx_id:
                         del self._pending[ref]
             deferred, self._deferred = self._deferred, []
-        now = _time.time()
+            self._inflight -= 1
         for refs, tx_id, caller, trace_ctx, fut, t_defer in deferred:
-            # defer wait: parked behind a pending-overlap blocker until
-            # this batch's completion re-screened it
-            self._record_wait(trace_ctx, "wait.group_commit_defer",
-                              "group_commit.defer", t_defer, now)
-            self._admit(refs, tx_id, caller, trace_ctx, fut)
+            self._admit(refs, tx_id, caller, trace_ctx, fut, t_defer=t_defer)
+        for req in provisional:
+            self._admit(req.refs, req.tx_id, req.caller, req.trace_ctx,
+                        req.future)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -18,8 +18,17 @@ class _LeaderUnknown(RuntimeError):
     """Transient leaderless window — retried by consensus_round."""
 
 
+class _RoundStuck(concurrent.futures.TimeoutError):
+    """A submit whose pending entry outlived the per-attempt wait —
+    typically stranded on a deposed leader whose log entry will never
+    reach quorum. Abandoned and re-submitted: every state-machine
+    command is idempotent for the same tx, so a re-submit that races a
+    late commit of the original entry just reads its own verdict."""
+
+
 def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
-                    on_attempt=None, site: str = "raft.submit"):
+                    on_attempt=None, site: str = "raft.submit",
+                    attempt_timeout_s: float | None = None):
     """One blocking replicated-state-machine round: submit ``command`` to
     `backend` (RaftNode or BFTClient), retrying leaderless windows with
     decorrelated-jitter backoff inside the timeout budget, abandoning the
@@ -28,7 +37,12 @@ def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
     given) is called once per actual submit, the seam the GroupCommitter
     uses to count real raft appends. ``site`` names the retry site on the
     Retry.* meters, so distinct callers — the per-transaction path vs the
-    GroupCommitter's batched cut — burn visibly separate retry budgets."""
+    GroupCommitter's batched cut — burn visibly separate retry budgets.
+
+    ``attempt_timeout_s`` bounds ONE submit's wait: a round still pending
+    after that long is abandoned and re-submitted (fresh leader lookup)
+    instead of burning the whole ``timeout_s`` on an entry stranded on a
+    deposed leader. None keeps the single-wait behaviour."""
 
     def _submit(ctx):
         kwargs = {}
@@ -37,11 +51,16 @@ def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
         if on_attempt is not None:
             on_attempt()
         fut = backend.submit(command, **kwargs)
+        wait_s = timeout_s if attempt_timeout_s is None \
+            else min(attempt_timeout_s, timeout_s)
         try:
-            return fut.result(timeout=timeout_s)
+            return fut.result(timeout=wait_s)
         except concurrent.futures.TimeoutError:
             backend.abandon(fut)
-            raise
+            if attempt_timeout_s is None:
+                raise
+            raise _RoundStuck(
+                f"round still pending after {wait_s:g}s at {site}")
         except RuntimeError as e:
             # only the leadership errors are retryable; anything else
             # (a replica bug, a closed backend) propagates immediately
@@ -63,11 +82,13 @@ def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
                 duration_s=_time.time() - t0,
                 wait_kind="raft.leaderless", site=site)
 
+    retry_on = (_LeaderUnknown,) if attempt_timeout_s is None \
+        else (_LeaderUnknown, _RoundStuck)
     return retry.retry_call(
         lambda: _submit(trace_ctx), site=site,
         policy=retry.RetryPolicy(base_s=0.05, cap_s=0.5, max_attempts=6,
                                  deadline_s=timeout_s),
-        retry_on=(_LeaderUnknown,), sleep=_sleep_traced)
+        retry_on=retry_on, sleep=_sleep_traced)
 
 
 def consensus_commit(backend, states, tx_id, caller: str,
@@ -93,10 +114,21 @@ def consensus_commit(backend, states, tx_id, caller: str,
                            n_states=len(states), caller=caller) as sp:
         ctx = sp.context() or trace_ctx
         t0 = _time.perf_counter()
+        deadline = _time.monotonic() + timeout_s
         try:
-            result = consensus_round(
-                backend, ("put_all", [tx_id, list(states), caller]),
-                timeout_s, trace_ctx=ctx)
+            while True:
+                result = consensus_round(
+                    backend, ("put_all", [tx_id, list(states), caller]),
+                    timeout_s, trace_ctx=ctx)
+                if result["committed"] or not result.get("provisional"):
+                    break
+                # every conflict is a revocable cross-shard reservation:
+                # the holder may release, so retry inside the timeout
+                # budget instead of handing back a terminal double-spend
+                # verdict for a state that was never consumed
+                if _time.monotonic() + 0.05 >= deadline:
+                    break
+                _time.sleep(0.05)
         finally:
             if metrics is not None:
                 trace_id = getattr(ctx, "trace_id", None)

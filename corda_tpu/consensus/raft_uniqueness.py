@@ -18,16 +18,49 @@ class DistributedImmutableMap:
 
     def __init__(self):
         self._map: dict = {}
+        #: provisional cross-shard reservations (sharded_uniqueness 2PC
+        #: phase 1): ref -> ConsumedStateDetails of the COORDINATING tx.
+        #: A reservation blocks every other spender until the coordinator
+        #: finalizes or releases it — but unlike a consumed entry it is
+        #: REVOCABLE, so verdicts it causes are flagged ``provisional``
+        #: (the blocked spender defers and retries; it is not dead).
+        self._reserved: dict = {}
+
+    def _conflicts(self, refs, tx_id) -> tuple:
+        """find_conflicts over consumed entries PLUS other-tx reservations
+        (a reserved ref reports the reserving tx so the loser can retry
+        after the reservation resolves). Returns ``(conflicts,
+        provisional)`` — provisional is True when every conflict comes
+        from a revocable reservation and none from the immutable applied
+        map, i.e. the verdict may change once the holder resolves."""
+        from ..node.notary import find_conflicts
+        conflicts = find_conflicts(self._map, refs, tx_id)
+        terminal = bool(conflicts)
+        for ref in refs:
+            held = self._reserved.get(ref)
+            if held is not None and held.consuming_tx != tx_id \
+                    and ref not in conflicts:
+                conflicts[ref] = held
+        return conflicts, bool(conflicts) and not terminal
+
+    @staticmethod
+    def _rejection(conflicts: dict, provisional: bool) -> dict:
+        out = {"committed": False, "conflicts": conflicts}
+        if provisional:
+            out["provisional"] = True
+        return out
 
     def apply(self, command) -> dict:
-        from ..node.notary import find_conflicts, record_all
+        from ..node.notary import ConsumedStateDetails, record_all
         kind, payload = command
         if kind == "put_all":
             tx_id, refs, caller = payload
-            conflicts = find_conflicts(self._map, refs, tx_id)
+            conflicts, provisional = self._conflicts(refs, tx_id)
             if conflicts:
-                return {"committed": False, "conflicts": conflicts}
+                return self._rejection(conflicts, provisional)
             record_all(self._map, refs, tx_id, caller)
+            for ref in refs:           # fast path supersedes own reservation
+                self._reserved.pop(ref, None)
             return {"committed": True, "conflicts": {}}
         if kind == "put_all_batch":
             # Group commit (commit_pipeline.GroupCommitter): one log entry
@@ -38,14 +71,57 @@ class DistributedImmutableMap:
             # every replica (apply order == list order == log order).
             results = []
             for tx_id, refs, caller in payload:
-                conflicts = find_conflicts(self._map, refs, tx_id)
+                conflicts, provisional = self._conflicts(refs, tx_id)
                 if conflicts:
-                    results.append({"committed": False,
-                                    "conflicts": conflicts})
+                    results.append(self._rejection(conflicts, provisional))
                 else:
                     record_all(self._map, refs, tx_id, caller)
+                    for ref in refs:
+                        self._reserved.pop(ref, None)
                     results.append({"committed": True, "conflicts": {}})
             return {"batch": True, "results": results}
+        if kind == "reserve_all":
+            # 2PC phase 1: provisional first-spender-wins claim. Same
+            # verdict machinery as put_all (idempotent for the same tx on
+            # replay), but the claim is revocable via release_all.
+            tx_id, refs, caller = payload
+            conflicts, provisional = self._conflicts(refs, tx_id)
+            if conflicts:
+                return self._rejection(conflicts, provisional)
+            for i, ref in enumerate(refs):
+                if ref not in self._map:   # already-consumed-by-self stays
+                    self._reserved[ref] = ConsumedStateDetails(
+                        consuming_tx=tx_id, consuming_index=i,
+                        requesting_party=caller)
+            return {"committed": True, "conflicts": {}}
+        if kind == "finalize_all":
+            # 2PC phase 2 (commit): promote the reservation to a consumed
+            # entry. Idempotent on replay; records directly even if the
+            # reservation was lost (the durable decision record is the
+            # commit point, not the reservation); never overwrites another
+            # tx's consumption — that would be a protocol violation, so it
+            # is reported as a conflict verdict instead.
+            tx_id, refs, caller = payload
+            conflicts = {ref: held for ref in refs
+                         if (held := self._map.get(ref)) is not None
+                         and held.consuming_tx != tx_id}
+            if conflicts:
+                return {"committed": False, "conflicts": conflicts}
+            record_all(self._map, refs, tx_id, caller)
+            for ref in refs:
+                self._reserved.pop(ref, None)
+            return {"committed": True, "conflicts": {}}
+        if kind == "release_all":
+            # 2PC phase 2 (abort): drop this tx's reservations so honest
+            # retries succeed. Idempotent; never touches another holder.
+            tx_id, refs = payload[0], payload[1]
+            released = 0
+            for ref in refs:
+                held = self._reserved.get(ref)
+                if held is not None and held.consuming_tx == tx_id:
+                    del self._reserved[ref]
+                    released += 1
+            return {"committed": True, "conflicts": {}, "released": released}
         raise ValueError(f"unknown command {kind!r}")
 
     def __len__(self):
@@ -54,11 +130,16 @@ class DistributedImmutableMap:
     # -- state transfer (BFT catch-up / future raft snapshots) ---------------
     def snapshot(self) -> bytes:
         from ..core.serialization import serialize
-        return serialize(self._map)
+        return serialize([self._map, self._reserved])
 
     def restore(self, blob: bytes) -> None:
         from ..core.serialization import deserialize
-        self._map = dict(deserialize(blob))
+        obj = deserialize(blob)
+        if isinstance(obj, dict):          # pre-shard snapshot: consumed only
+            self._map, self._reserved = dict(obj), {}
+        else:
+            consumed, reserved = obj
+            self._map, self._reserved = dict(consumed), dict(reserved)
 
 
 class RaftUniquenessProvider(UniquenessProvider):
@@ -69,6 +150,9 @@ class RaftUniquenessProvider(UniquenessProvider):
         self.raft = raft_node
         self.timeout_s = timeout_s
         self._committer = None   # lazy GroupCommitter (commit_async path)
+        #: GroupCommitter keyword overrides (the sharded provider tunes
+        #: max_batch / inflight and sets a per-shard ``label`` here).
+        self.committer_opts: dict = {}
 
     @staticmethod
     def build(node_id: str, peers: list[str], messaging,
@@ -129,9 +213,18 @@ class RaftUniquenessProvider(UniquenessProvider):
         if committer is None:
             from .commit_pipeline import GroupCommitter
             sm = getattr(self, "state_machine", None)
+            # The applied map is immutable-growing, so a hit there is a
+            # terminal reject. A ref provisionally held by a cross-shard
+            # tx is NOT: the reservation is revocable, so it feeds the
+            # committer's defer machinery (reserved_view) instead — the
+            # blocked spender re-screens when the holder resolves rather
+            # than receiving a false permanent double-spend verdict.
+            view = (lambda: sm._map) if sm is not None else None
+            rview = (lambda: sm._reserved) if sm is not None else None
             committer = GroupCommitter(
                 self.raft, timeout_s=self.timeout_s, metrics=metrics,
-                applied_view=(lambda: sm._map) if sm is not None else None)
+                applied_view=view, reserved_view=rview,
+                **self.committer_opts)
             self._committer = committer
         return committer.submit(states, tx_id, caller, trace_ctx=trace_ctx)
 

@@ -165,6 +165,18 @@ class NotaryService:
     #: supports_trace_ctx): callers may pass their span context through
     supports_trace_ctx = True
 
+    def _shard_tags(self, refs) -> dict:
+        """``{"shards": "s0+s2"}`` when the uniqueness backend partitions
+        the ref domain (sharded provider), else nothing — keeps the
+        notary.uniqueness span shape unchanged for single-log backends."""
+        describe = getattr(self.uniqueness, "touched_shards", None)
+        if describe is None:
+            return {}
+        try:
+            return {"shards": describe(refs)}
+        except Exception:
+            return {}
+
     def commit(self, input_refs, tx_id, caller_name: str,
                trace_ctx=None) -> None:
         import time as _time
@@ -185,7 +197,8 @@ class NotaryService:
             uctx = sp.context() or trace_ctx
             with get_tracer().span("notary.uniqueness", parent=uctx,
                                    tx_id=tx_id.bytes.hex()[:16],
-                                   n_inputs=len(refs)) as usp:
+                                   n_inputs=len(refs),
+                                   **self._shard_tags(refs)) as usp:
                 kwargs = {}
                 if getattr(self.uniqueness, "supports_trace_ctx", False):
                     kwargs["trace_ctx"] = usp.context() or uctx
@@ -234,7 +247,8 @@ class NotaryService:
                          caller=caller_name, group_commit=True)
         uctx = sp.context() or trace_ctx
         usp = tracer.span("notary.uniqueness", parent=uctx,
-                          tx_id=tx_id.bytes.hex()[:16], n_inputs=len(refs))
+                          tx_id=tx_id.bytes.hex()[:16], n_inputs=len(refs),
+                          **self._shard_tags(refs))
         t0 = _time.perf_counter()
         inner = self.uniqueness.commit_async(
             refs, tx_id, caller_name, trace_ctx=usp.context() or uctx,

@@ -43,7 +43,7 @@ __all__ = [
 #: in exactly one of these.
 COMPONENTS = ("flow.compute", "scheduler.wait", "verify",
               "notary.batch_wait", "raft.commit", "raft.leaderless",
-              "vault", "network", "other")
+              "cross_shard", "vault", "network", "other")
 
 #: wait_kind taxonomy: tag value -> blame component. One row per
 #: commit-path queueing point (docs/OBSERVABILITY.md, tail forensics).
@@ -57,6 +57,7 @@ WAIT_KINDS = {
     "group_commit.defer": "notary.batch_wait",  # pending-overlap defer
     "group_commit.round": "raft.commit",       # consensus round in flight
     "raft.leaderless": "raft.leaderless",      # retry backoff sleep
+    "cross_shard.prepare": "cross_shard",      # 2PC reserve rounds (sharded)
 }
 
 #: (span-name prefix, component) — first match wins; checked after the
@@ -65,6 +66,7 @@ _NAME_RULES = (
     ("wait.scheduler_admission", "scheduler.wait"),
     ("wait.verifier_admission", "verify"),
     ("wait.verify", "verify"),
+    ("wait.cross_shard_prepare", "cross_shard"),
     ("wait.group_commit_round", "raft.commit"),
     ("wait.group_commit", "notary.batch_wait"),
     ("wait.raft_leaderless", "raft.leaderless"),

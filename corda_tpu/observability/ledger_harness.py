@@ -52,6 +52,7 @@ The report feeds ``bench.py --ledger`` → ``LEDGER_r0*.json`` →
 """
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -59,7 +60,8 @@ from dataclasses import dataclass, field
 
 from .critpath import ledger_critpath_fields
 from .slo import DEFAULT_OBJECTIVES, SLOTracker
-from .stages import group_commit_fields, ledger_stage_percentiles
+from .stages import (group_commit_fields, ledger_shard_fields,
+                     ledger_stage_percentiles)
 
 #: the span tree one committed, notarised transaction leaves behind when
 #: every stage is instrumented and stitched (ISSUE 10 acceptance: these
@@ -126,6 +128,13 @@ class LedgerScenarioConfig:
     #: spends; the artifact records the rejection rate (1.0 or the
     #: notary's safety broke).
     double_spend_replays: int = 0
+    #: notary shards (ISSUE 15): >1 partitions the uniqueness domain
+    #: across this many raft groups behind a ShardedUniquenessProvider.
+    shards: int = 1
+    #: fraction of payments forced multi-input ("big" pays spending two
+    #: coins), so their input refs straddle shards with probability
+    #: (shards-1)/shards — the cross-shard 2PC traffic mix.
+    cross_shard_pct: float = 0.0
 
     @staticmethod
     def full(seed: int = 7, chaos: bool = True) -> "LedgerScenarioConfig":
@@ -134,6 +143,24 @@ class LedgerScenarioConfig:
             coins_per_party=6, node_concurrency=4,
             seed=seed, chaos=chaos, max_duration_s=300.0,
             trace_capacity=65536, mode="full")
+
+    @staticmethod
+    def sharded(shards: int = 2, cross_shard_pct: float = 0.35,
+                seed: int = 7, full: bool = False) -> "LedgerScenarioConfig":
+        """Sharded-notary preset (tools/scenario.py --shards): N raft
+        groups, a payment mix with a configurable cross-shard fraction,
+        and enough post-issuance traffic that nonzero cross-shard commits
+        are guaranteed for the gate."""
+        if full:
+            cfg = LedgerScenarioConfig.full(seed=seed, chaos=True)
+            cfg.shards, cfg.cross_shard_pct = shards, cross_shard_pct
+            cfg.mode = "sharded"
+            return cfg
+        return LedgerScenarioConfig(
+            parties=4, operations=40, rate_tx_per_sec=10.0,
+            coins_per_party=3, shards=shards,
+            cross_shard_pct=cross_shard_pct, seed=seed,
+            mode="sharded-smoke")
 
     @staticmethod
     def hot_state(seed: int = 7, full: bool = False
@@ -165,6 +192,7 @@ class _Op:
     intended_s: float             # offset from run start (open-loop clock)
     initiator: int                # node index into the driver's node list
     counterparty: int | None = None
+    big: bool = False             # multi-coin pay (cross-shard pressure)
     step: int = 0                 # settle: 0 = CP self-issue, 1 = DvP
     future: object | None = None  # FlowScheduler proxy for the current leg
     launch_rel: float | None = None  # when the current leg actually started
@@ -199,8 +227,14 @@ def _build_ops(cfg: LedgerScenarioConfig) -> list[_Op]:
             if other >= seller:
                 other += 1
         kind = "settle" if rng.random() < cfg.settle_fraction else "pay"
+        # "big" pays gather two coins (issue amount + pay amount exceeds
+        # any single coin) so the tx has multi-shard input refs; the
+        # short-circuit keeps the rng stream identical when the knob is
+        # off, preserving pre-shard workloads byte-for-byte.
+        big = bool(cfg.cross_shard_pct) and kind == "pay" and \
+            rng.random() < cfg.cross_shard_pct
         ops.append(_Op(kind, len(ops), len(ops) / cfg.rate_tx_per_sec,
-                       initiator=seller, counterparty=other))
+                       initiator=seller, counterparty=other, big=big))
     return ops
 
 
@@ -377,14 +411,27 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
         node.services.verifier_service = verifier
     notary.services.slo_tracker = slo
 
-    # raft cluster as extra bus endpoints + background pump
-    names = [f"raft{i}" for i in range(cfg.raft_replicas)]
-    machines = [DistributedImmutableMap() for _ in names]
-    providers = [RaftUniquenessProvider.build(
-        n, names, network.bus.create_node(n), state_machine=machines[i],
-        seed=cfg.seed + i, native=False) for i, n in enumerate(names)]
+    # raft cluster(s) as extra bus endpoints + background pump. shards>1
+    # builds one independent raft group PER SHARD; shard 0 keeps the
+    # historical "raftN" names so single-shard runs are unchanged.
+    n_shards = max(1, cfg.shards)
+    shard_names = [[f"raft{i}" if n_shards == 1 else f"s{s}r{i}"
+                    for i in range(cfg.raft_replicas)]
+                   for s in range(n_shards)]
+    shard_machines = [[DistributedImmutableMap() for _ in grp]
+                      for grp in shard_names]
+    shard_providers = [[RaftUniquenessProvider.build(
+        n, grp, network.bus.create_node(n),
+        state_machine=shard_machines[s][i],
+        seed=cfg.seed + 31 * s + i, native=False)
+        for i, n in enumerate(grp)]
+        for s, grp in enumerate(shard_names)]
+    names = [n for grp in shard_names for n in grp]
+    machines = [m for grp in shard_machines for m in grp]
+    providers = [p for grp in shard_providers for p in grp]
     for p in providers:
         p.timeout_s = cfg.provider_timeout_s
+    shard_rafts = [[p.raft for p in grp] for grp in shard_providers]
     raft_nodes = [p.raft for p in providers]
     raft_names = set(names)
     stop = threading.Event()
@@ -405,13 +452,27 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
     report: dict = {}
     try:
         deadline = time.monotonic() + 15
-        while not any(rn.role == LEADER for rn in raft_nodes):
-            if time.monotonic() > deadline:
-                raise TimeoutError("no raft leader elected")
-            time.sleep(0.01)
-        leader = next(rn for rn in raft_nodes if rn.role == LEADER)
-        notary.install_notary(ValidatingNotaryService,
-                              uniqueness=providers[raft_nodes.index(leader)])
+        shard_entry = []
+        for s, group in enumerate(shard_rafts):
+            while not any(rn.role == LEADER for rn in group):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"no raft leader elected (shard {s})")
+                time.sleep(0.01)
+            leader = next(rn for rn in group if rn.role == LEADER)
+            shard_entry.append(shard_providers[s][group.index(leader)])
+        if n_shards == 1:
+            uniq_provider = shard_entry[0]
+            uniq_provider.committer_opts = {"label": "s0"}
+            notary.install_notary(ValidatingNotaryService,
+                                  uniqueness=uniq_provider)
+        else:
+            from ..consensus.sharded_uniqueness import (
+                ShardedNotaryService, ShardedUniquenessProvider)
+            uniq_provider = ShardedUniquenessProvider(
+                shard_entry, timeout_s=cfg.provider_timeout_s,
+                metrics=registry)
+            notary.install_notary(ShardedNotaryService,
+                                  uniqueness=uniq_provider)
 
         ops = _build_ops(cfg)
         chaos = _ChaosSchedule(cfg, raft_nodes,
@@ -451,7 +512,11 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
                                      parties[op.initiator].party,
                                      notary.party)
             if op.kind == "pay":
-                return CashPaymentFlow(_dollars(cfg.pay_dollars),
+                # big pays exceed any single coin, so generate_spend
+                # gathers ≥2 coins — multi-shard inputs → cross-shard 2PC
+                amount = cfg.issue_dollars + cfg.pay_dollars if op.big \
+                    else cfg.pay_dollars
+                return CashPaymentFlow(_dollars(amount),
                                        parties[op.counterparty].party)
             if op.step == 0:         # settle leg 1: CP self-issue
                 from ..flows.library import FinalityFlow
@@ -572,7 +637,7 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
         if cfg.double_spend_replays and committed_notarised:
             from ..core.crypto.secure_hash import SecureHash
             from ..node.notary import UniquenessException
-            provider = providers[raft_nodes.index(leader)]
+            provider = uniq_provider
             rng = random.Random(cfg.seed ^ 0xD5)
             for k in range(cfg.double_spend_replays):
                 tx_id, refs = committed_notarised[
@@ -592,20 +657,44 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
                 except Exception:
                     pass   # a timeout is neither acceptance nor rejection
 
-        # -- exactly-once + replica agreement --------------------------------
+        # -- in-doubt 2PC recovery (sharded) ----------------------------------
+        # A chaos window can kill a cross-shard coordinator between prepare
+        # and finalize; resolve from the durable decision record BEFORE the
+        # invariant pass, exactly as a restarted coordinator would.
+        recovered_in_doubt: list = []
+        if n_shards > 1:
+            try:
+                recovered_in_doubt = uniq_provider.recover_in_doubt()
+            except Exception:
+                pass
+
+        # -- exactly-once + replica agreement (per shard) ---------------------
+        from ..consensus.sharded_uniqueness import shard_of
+
+        def _home(ref):
+            """Replicas of the shard that owns this ref's uniqueness."""
+            return shard_machines[shard_of(ref, n_shards)]
+
         exactly_once_ok = True
         for tx_id, refs in committed_notarised:
-            for m in machines:
-                for ref in refs:
+            for ref in refs:
+                for m in _home(ref):
                     details = m._map.get(ref)
                     if details is None or details.consuming_tx != tx_id:
                         exactly_once_ok = False
         agree_deadline = time.monotonic() + 10
         replicas_agree = False
+        reserved_leftover = sum(len(m._reserved) for m in machines)
         while time.monotonic() < agree_deadline:
-            views = [{ref: d.consuming_tx for ref, d in m._map.items()}
-                     for m in machines]
-            if all(v == views[0] for v in views[1:]):
+            agree = True
+            for group in shard_machines:
+                views = [{ref: d.consuming_tx for ref, d in m._map.items()}
+                         for m in group]
+                if not all(v == views[0] for v in views[1:]):
+                    agree = False
+                    break
+            reserved_leftover = sum(len(m._reserved) for m in machines)
+            if agree and reserved_leftover == 0:
                 replicas_agree = True
                 break
             time.sleep(0.05)        # followers may still be catching up
@@ -618,7 +707,7 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
                 m._map.get(ref) is not None
                 and m._map[ref].consuming_tx == tx_id
                 for tx_id, refs in committed_notarised
-                for m in machines for ref in refs)
+                for ref in refs for m in _home(ref))
 
         # -- report -----------------------------------------------------------
         traces = get_tracer().traces()
@@ -646,6 +735,10 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
             "node_concurrency": cfg.node_concurrency,
             "raft_replicas": cfg.raft_replicas,
             "seed": cfg.seed,
+            # host fingerprint: benchguard fits floors within a host class
+            # only — open-loop rates recorded on a big box are not floors
+            # a small one can be held to (benchguard.same_host_class)
+            "host_cpus": os.cpu_count() or 1,
             "ops_total": len(ops),
             "ops_committed": len(committed_ops),
             "ops_failed": len(ops) - len(committed_ops),
@@ -695,6 +788,10 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
                     _percentile(flow_k, qv) * 1000, 3)
         report.update(ledger_stage_percentiles(snapshot))
         report.update(group_commit_fields(snapshot))
+        report.update(ledger_shard_fields(snapshot, n_shards))
+        report["cross_shard_pct"] = cfg.cross_shard_pct
+        report["ledger_shard_reserved_leftover"] = reserved_leftover
+        report["ledger_shard_recovered_in_doubt"] = len(recovered_in_doubt)
         # tail forensics: per-flow-class critical-path blame vectors over
         # the stitched span trees (critpath.py). Each p50/p99 vector is
         # the decomposition of that quantile's transaction, so its
@@ -716,6 +813,13 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
         return report
     finally:
         faults.disarm()
+        if n_shards > 1:
+            try:
+                # shuts the 2PC coordinator pool down before the per-replica
+                # committers (provider.close below is a no-op re-close)
+                uniq_provider.close()
+            except Exception:
+                pass
         for p in providers:
             try:
                 p.close()          # stop GroupCommitter tick/flush threads
@@ -728,3 +832,397 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
         except Exception:
             pass
         set_tracer(prior_tracer)
+
+
+# ---------------------------------------------------------------------------
+# Shard-scaling sweep (ISSUE 15): the measured tx/s-vs-shards curve.
+#
+# The full-flow scenario above is host-CPU bound (LEDGER_r03 critpath: the
+# p50 payment spends ~1.7 s in flow.compute and ~1.8 s in verify against
+# 0.004 ms in raft.commit), so it cannot show what sharding buys the NOTARY
+# TIER — the flows would bottleneck first at any shard count. The sweep
+# therefore saturates the uniqueness tier directly: an open-loop driver
+# fires pre-bucketed StateRefs through the REAL ShardedUniquenessProvider
+# (per-shard 3-replica raft groups, per-shard GroupCommitters, real 2PC for
+# the cross-shard fraction, real chaos windows) with the committers tuned
+# small (max_batch 8, one round in flight) so each shard's capacity is
+# consensus-round bound — batch/RTT — not host-CPU bound. Consensus waits
+# are sleeps that release the GIL, so N shards wait in parallel and the
+# curve measures real horizontal scaling, not Python scheduling noise.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ShardSweepConfig:
+    """One point of the scaling curve. Defaults are the full-measurement
+    shape; bench.py --smoke shrinks operations/rate."""
+
+    shards: int = 2
+    operations: int = 1600
+    rate_tx_per_sec: float = 1500.0   # offered above any point's capacity
+    cross_shard_pct: float = 0.06     # fraction running the 2PC
+    conflict_pct: float = 0.02        # deliberate double spends (abort path)
+    raft_replicas: int = 3
+    seed: int = 7
+    chaos: bool = False
+    chaos_partition_s: float = 2.0
+    chaos_append_drop_p: float = 0.15
+    timeout_s: float = 30.0
+    #: per-attempt consensus bound: a round stranded on a chaos-deposed
+    #: leader re-submits after this long instead of serialising its whole
+    #: shard pipeline behind timeout_s (provider.consensus_round)
+    attempt_timeout_s: float = 1.0
+    max_duration_s: float = 120.0
+    #: batch 4 / one round in flight / 12 ms pump: per-shard capacity
+    #: ~= 4 / (pump RTT) ~= 300 tx/s, far below the one-interpreter
+    #: ceiling, so added shards show up as throughput, not GIL contention
+    committer_max_batch: int = 4
+    committer_max_latency_s: float = 0.002
+    committer_inflight: int = 1
+    pump_interval_s: float = 0.012
+    coordinator_workers: int = 16
+
+
+class _SweepChaos:
+    """Progress-anchored chaos for the sweep: windows arm when the
+    RESOLVED fraction crosses 20 % / 50 % / 75 % — not at wall-clock
+    offsets — so a 4-shard run that drains 4× faster takes the same
+    proportional fault pressure as the 1-shard run and the curve compares
+    like with like. Window width is ~8 % of the projected run length
+    (floor 0.25 s, ceiling ``chaos_partition_s``), proportional again."""
+
+    def __init__(self, cfg: ShardSweepConfig, raft_nodes):
+        self.cfg = cfg
+        self.raft_nodes = raft_nodes
+        self.pending = [("partition_follower", 0.20), ("leader_kill", 0.50),
+                        ("append_drop", 0.75)]
+        self._active = None          # (kind, end_monotonic, detail)
+        self.annotations: list[dict] = []
+
+    def _rules(self, kind: str):
+        from ..consensus.raft import LEADER
+        from ..utils.faults import FaultRule
+        if kind == "append_drop":
+            return ([FaultRule("raft.append", "drop",
+                               probability=self.cfg.chaos_append_drop_p)],
+                    f"p={self.cfg.chaos_append_drop_p}")
+        leaders = [rn.node_id for rn in self.raft_nodes
+                   if rn.role == LEADER]
+        followers = [rn.node_id for rn in self.raft_nodes
+                     if rn.node_id not in leaders]
+        if kind == "leader_kill" and leaders:
+            target = leaders[0]
+        else:
+            target = (followers or [self.raft_nodes[-1].node_id])[0]
+        return ([FaultRule("net.send", "drop", detail=f"{target}->*"),
+                 FaultRule("net.send", "drop", detail=f"*->{target}")],
+                target)
+
+    def tick(self, frac: float, elapsed_s: float) -> None:
+        from ..utils import faults
+        now = time.monotonic()
+        if self._active is not None:
+            kind, end, detail = self._active
+            if now >= end:
+                inj = faults.active()
+                faults.disarm()
+                self.annotations.append({
+                    "kind": kind, "at_progress": round(frac, 3),
+                    "detail": detail,
+                    "faults_fired": len(inj.log) if inj else 0})
+                self._active = None
+            return
+        if not self.pending or frac < self.pending[0][1] or frac <= 0:
+            return
+        kind, _thr = self.pending.pop(0)
+        projected = elapsed_s / max(frac, 1e-6)
+        width = max(0.25, min(self.cfg.chaos_partition_s, 0.08 * projected))
+        rules, detail = self._rules(kind)
+        inj = faults.FaultInjector(seed=self.cfg.seed)
+        for r in rules:
+            inj.add(r)
+        faults.arm(inj)
+        self._active = (kind, now + width, detail)
+
+    def close(self, frac: float) -> None:
+        from ..utils import faults
+        if self._active is not None:
+            kind, _end, detail = self._active
+            inj = faults.active()
+            faults.disarm()
+            self.annotations.append({
+                "kind": kind, "at_progress": round(frac, 3),
+                "detail": detail,
+                "faults_fired": len(inj.log) if inj else 0})
+            self._active = None
+
+
+def run_shard_sweep_point(cfg: ShardSweepConfig | None = None) -> dict:
+    """Measure ONE shard count under notary saturation and verify the
+    safety invariants (per-shard exactly-once, replica agreement, zero
+    leftover reservations after in-doubt recovery). Returns one
+    ``shard_sweep`` entry for the LEDGER artifact."""
+    from ..consensus.raft import LEADER
+    from ..consensus.raft_uniqueness import (DistributedImmutableMap,
+                                             RaftUniquenessProvider)
+    from ..consensus.sharded_uniqueness import (ShardedUniquenessProvider,
+                                                shard_of)
+    from ..core.contracts.structures import StateRef
+    from ..core.crypto.secure_hash import SecureHash
+    from ..network.inmemory import InMemoryMessagingNetwork
+    from ..node.notary import UniquenessException
+    from ..utils import faults
+    from ..utils.metrics import MetricRegistry
+
+    cfg = cfg if cfg is not None else ShardSweepConfig()
+    n_shards = max(1, cfg.shards)
+    rng = random.Random(cfg.seed * 1000003 + n_shards)
+    bus = InMemoryMessagingNetwork()
+    registry = MetricRegistry()
+
+    shard_names = [[f"s{s}r{i}" for i in range(cfg.raft_replicas)]
+                   for s in range(n_shards)]
+    shard_machines = [[DistributedImmutableMap() for _ in grp]
+                      for grp in shard_names]
+    shard_providers = [[RaftUniquenessProvider.build(
+        n, grp, bus.create_node(n), state_machine=shard_machines[s][i],
+        seed=cfg.seed + 31 * s + i, native=False)
+        for i, n in enumerate(grp)]
+        for s, grp in enumerate(shard_names)]
+    stop = threading.Event()
+
+    def pump(shard: int):
+        group = shard_providers[shard]
+        names = shard_names[shard]
+        while not stop.is_set():
+            for p in group:
+                p.raft.tick()
+            # drain the group to QUIESCENCE each iteration: a tick's
+            # AppendEntries, the followers' acks, and the leader's commit
+            # all land inside one pass regardless of which replica holds
+            # leadership — otherwise the round RTT depends on the
+            # leader's position in the drain order (an extra full pump
+            # interval when it drains before its followers reply)
+            while True:
+                delivered = False
+                for name in names:
+                    while bus.pump_receive(name) is not None:
+                        delivered = True
+                if not delivered:
+                    break
+            # the sleep IS the design: consensus RTT dominates per-shard
+            # capacity and sleeping releases the GIL, so shards wait in
+            # parallel instead of serializing on the interpreter
+            time.sleep(cfg.pump_interval_s)
+
+    pumps = [threading.Thread(target=pump, args=(s,), daemon=True,
+                              name=f"sweep-pump-s{s}")
+             for s in range(n_shards)]
+    for t in pumps:
+        t.start()
+
+    sharded = None
+    try:
+        deadline = time.monotonic() + 15
+        entry = []
+        for s, grp in enumerate(shard_providers):
+            while not any(p.raft.role == LEADER for p in grp):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"no raft leader (shard {s})")
+                time.sleep(0.01)
+            leader = next(p for p in grp if p.raft.role == LEADER)
+            leader.committer_opts = {
+                "max_batch": cfg.committer_max_batch,
+                "max_latency_s": cfg.committer_max_latency_s,
+                "max_inflight_batches": cfg.committer_inflight,
+                "attempt_timeout_s": cfg.attempt_timeout_s,
+            }
+            entry.append(leader)
+        sharded = ShardedUniquenessProvider(
+            entry, timeout_s=cfg.timeout_s, metrics=registry,
+            coordinator_workers=cfg.coordinator_workers,
+            attempt_timeout_s=cfg.attempt_timeout_s)
+
+        # pre-bucketed refs: rejection-sample fresh StateRefs by home shard
+        # so single-shard ops stay single-shard and cross-shard ops touch
+        # exactly two shards, deterministically per seed
+        pools: list[list] = [[] for _ in range(n_shards)]
+        quota = cfg.operations + 64
+        salt = 0
+        while any(len(p) < quota for p in pools):
+            ref = StateRef(SecureHash.sha256(
+                b"sweep:%d:%d:%d" % (cfg.seed, n_shards, salt)), 0)
+            pools[shard_of(ref, n_shards)].append(ref)
+            salt += 1
+
+        ops = []                 # (kind, tx_id, refs, intended_s)
+        spent_pool = []          # refs already used (conflict fodder)
+        for j in range(cfg.operations):
+            tx = SecureHash.sha256(b"sweeptx:%d:%d:%d"
+                                   % (cfg.seed, n_shards, j))
+            r = rng.random()
+            if spent_pool and r < cfg.conflict_pct:
+                prior = spent_pool[rng.randrange(len(spent_pool))]
+                refs, kind = [prior], "conflict"
+                if n_shards > 1 and rng.random() < 0.5:
+                    # cross-shard conflict: the 2PC must abort and release
+                    # the fresh ref it reserved alongside the spent one
+                    other = (shard_of(prior, n_shards) + 1) % n_shards
+                    refs = [prior, pools[other].pop()]
+            elif n_shards > 1 and r < cfg.conflict_pct + cfg.cross_shard_pct:
+                a = rng.randrange(n_shards)
+                b = (a + 1 + rng.randrange(n_shards - 1)) % n_shards
+                refs, kind = [pools[a].pop(), pools[b].pop()], "cross"
+            else:
+                refs, kind = [pools[j % n_shards].pop()], "single"
+            if kind != "conflict":
+                spent_pool.extend(refs)
+            ops.append((kind, tx, refs, j / cfg.rate_tx_per_sec))
+
+        chaos = _SweepChaos(cfg, [p.raft for grp in shard_providers
+                                  for p in grp]) if cfg.chaos else None
+        lock = threading.Lock()
+        outcomes: dict = {"committed": 0, "rejected": 0, "failed": 0}
+        latencies: list[float] = []
+        accepted: list = []      # (tx_id, refs) the provider confirmed
+        resolved = [0]
+        started = time.monotonic()
+        hard_stop = started + cfg.max_duration_s
+        launched = 0
+        total = len(ops)
+
+        def _done(fut, kind, tx, refs, intended):
+            err = fut.exception()
+            with lock:
+                resolved[0] += 1
+                if err is None:
+                    outcomes["committed"] += 1
+                    accepted.append((tx, refs))
+                    latencies.append(
+                        (time.monotonic() - started) - intended)
+                elif isinstance(err, UniquenessException):
+                    outcomes["rejected"] += 1
+                else:
+                    outcomes["failed"] += 1
+
+        while resolved[0] < total and time.monotonic() < hard_stop:
+            now_rel = time.monotonic() - started
+            if chaos is not None:
+                chaos.tick(resolved[0] / total, now_rel)
+            while launched < total and ops[launched][3] <= now_rel:
+                kind, tx, refs, intended = ops[launched]
+                fut = sharded.commit_async(refs, tx, "sweep")
+                fut.add_done_callback(
+                    lambda f, k=kind, t=tx, r=refs, i=intended:
+                    _done(f, k, t, r, i))
+                launched += 1
+            time.sleep(0.001)
+        duration_s = time.monotonic() - started
+        if chaos is not None:
+            chaos.close(resolved[0] / max(1, total))
+        faults.disarm()
+
+        # resolve anything a chaos window left in doubt, then require the
+        # reservation maps to drain on EVERY replica
+        recovered = sharded.recover_in_doubt()
+        machines = [m for grp in shard_machines for m in grp]
+        agree_deadline = time.monotonic() + 10
+        replicas_agree = False
+        reserved_leftover = sum(len(m._reserved) for m in machines)
+        while time.monotonic() < agree_deadline:
+            agree = all(
+                all({r: d.consuming_tx for r, d in m._map.items()} ==
+                    {r: d.consuming_tx for r, d in grp[0]._map.items()}
+                    for m in grp[1:])
+                for grp in shard_machines)
+            reserved_leftover = sum(len(m._reserved) for m in machines)
+            if agree and reserved_leftover == 0:
+                replicas_agree = True
+                break
+            time.sleep(0.05)
+        exactly_once_ok = replicas_agree
+        if replicas_agree:
+            for tx, refs in accepted:
+                for ref in refs:
+                    for m in shard_machines[shard_of(ref, n_shards)]:
+                        d = m._map.get(ref)
+                        if d is None or d.consuming_tx != tx:
+                            exactly_once_ok = False
+
+        lat = sorted(latencies)
+        snapshot = registry.snapshot()
+        return {
+            "shards": n_shards,
+            "operations": total,
+            "offered_tx_per_sec": cfg.rate_tx_per_sec,
+            "committed": outcomes["committed"],
+            "rejected": outcomes["rejected"],
+            "failed": outcomes["failed"],
+            "unresolved": total - resolved[0],
+            "committed_tx_per_sec":
+                round(outcomes["committed"] / duration_s, 3)
+                if duration_s else 0.0,
+            "duration_s": round(duration_s, 3),
+            "latency_ms_p50": round(_percentile(lat, 0.50) * 1000, 3),
+            "latency_ms_p99": round(_percentile(lat, 0.99) * 1000, 3),
+            "cross_shard_committed": int(
+                (snapshot.get("CrossShard.Committed") or {})
+                .get("count", 0)),
+            "cross_shard_aborted": int(
+                (snapshot.get("CrossShard.Aborted") or {}).get("count", 0)),
+            "recovered_in_doubt": len(recovered),
+            "exactly_once_ok": exactly_once_ok,
+            "replicas_agree": replicas_agree,
+            "reserved_leftover": reserved_leftover,
+            "chaos_windows": chaos.annotations if chaos is not None else [],
+            "chaos_enabled": bool(cfg.chaos),
+        }
+    finally:
+        faults.disarm()
+        if sharded is not None:
+            try:
+                sharded.close()
+            except Exception:
+                pass
+        for grp in shard_providers:
+            for p in grp:
+                try:
+                    p.close()
+                except Exception:
+                    pass
+        stop.set()
+        for t in pumps:
+            t.join(timeout=5)
+
+
+def shard_scaling_fields(points: list[dict]) -> dict:
+    """Flatten a sweep ([run_shard_sweep_point per shard count]) into the
+    LEDGER artifact's scaling-curve fields benchguard locks:
+    ``committed_tx_per_sec_shards_N`` per point, the efficiency of the
+    biggest point against linear scaling from the 1-shard baseline, and
+    the sweep's aggregate abort rate — named ``shard_sweep_abort_rate``
+    so it can never collide with (and silently overwrite) the flows
+    scenario's ``cross_shard_abort_rate``, which describes a different
+    workload."""
+    points = sorted(points, key=lambda p: p["shards"])
+    out: dict = {"shard_sweep": points}
+    base = next((p for p in points if p["shards"] == 1), None)
+    top = points[-1] if points else None
+    for p in points:
+        out[f"committed_tx_per_sec_shards_{p['shards']}"] = \
+            p["committed_tx_per_sec"]
+    if base and top and base["committed_tx_per_sec"] > 0:
+        ratio = top["committed_tx_per_sec"] / base["committed_tx_per_sec"]
+        out["shard_scaling_x"] = round(ratio, 3)
+        out["shard_scaling_efficiency_pct"] = round(
+            100.0 * ratio / max(1, top["shards"]), 2)
+    else:
+        out["shard_scaling_x"] = 0.0
+        out["shard_scaling_efficiency_pct"] = 0.0
+    cross_c = sum(p.get("cross_shard_committed", 0) for p in points)
+    cross_a = sum(p.get("cross_shard_aborted", 0) for p in points)
+    out["shard_sweep_abort_rate"] = round(
+        cross_a / (cross_a + cross_c), 4) if (cross_a + cross_c) else 0.0
+    out["shard_sweep_ok"] = bool(points) and all(
+        p["exactly_once_ok"] and p["replicas_agree"]
+        and p["reserved_leftover"] == 0 for p in points)
+    return out

@@ -88,6 +88,41 @@ def group_commit_fields(snapshot: dict) -> dict:
     return out
 
 
+def ledger_shard_fields(snapshot: dict, n_shards: int) -> dict:
+    """Flatten the sharded-notary metrics into LEDGER artifact fields.
+    Always present (zero defaults, same stance as group_commit_fields):
+    a single-shard run reports ``ledger_shard_count`` 1 and zero
+    cross-shard activity rather than dropping the keys, so benchguard's
+    schema holds across topologies. Per-shard commit counts come from
+    the labeled ``GroupCommit.Committed{shard="sK"}`` meters (the
+    federation label-naming convention)."""
+    counts = {}
+    for k in range(max(1, n_shards)):
+        fam = snapshot.get(f'GroupCommit.Committed{{shard="s{k}"}}') or {}
+        counts[f"s{k}"] = int(fam.get("count", 0))
+    cross_c = int((snapshot.get("CrossShard.Committed") or {})
+                  .get("count", 0))
+    cross_a = int((snapshot.get("CrossShard.Aborted") or {}).get("count", 0))
+    return {
+        "ledger_shard_count": max(1, n_shards),
+        "ledger_shard_commit_counts": counts,
+        "ledger_shard_cross_committed": cross_c,
+        "ledger_shard_cross_aborted": cross_a,
+        "ledger_shard_cross_recovered": int(
+            (snapshot.get("CrossShard.Recovered") or {}).get("count", 0)),
+        # finalize verdicts that conflicted AFTER the durable commit
+        # decision: each one is a cross-shard atomicity violation left
+        # in-doubt (sharded_uniqueness.CrossShardAtomicityError) — any
+        # nonzero value is an alert, so it must be artifact-visible
+        "ledger_shard_finalize_conflicts": int(
+            (snapshot.get("CrossShard.FinalizeConflict") or {})
+            .get("count", 0)),
+        "cross_shard_abort_rate":
+            round(cross_a / (cross_a + cross_c), 4) if (cross_a + cross_c)
+            else 0.0,
+    }
+
+
 def ledger_stage_percentiles(snapshot: dict) -> dict:
     """Flatten the commit-path stage histograms into LEDGER artifact
     fields: ``ledger_stage_<stage>_ms_<q>``. Same omission rule as

@@ -382,6 +382,18 @@ LEDGER_REQUIRED: tuple = (
     "ledger_critpath_e2e_p50_ms_pay", "ledger_critpath_dominant_pay",
     "ledger_critpath_blame_p50_settle", "ledger_critpath_blame_p99_settle",
     "ledger_critpath_e2e_p50_ms_settle", "ledger_critpath_dominant_settle",
+    # sharded uniqueness (ISSUE 15): always present — a single-shard run
+    # reports shard_count 1 and zero cross-shard activity, so a wiring
+    # regression that silently drops the sharded provider fails here
+    "ledger_shard_count", "ledger_shard_commit_counts",
+    "ledger_shard_cross_committed", "ledger_shard_cross_aborted",
+    "ledger_shard_cross_recovered", "ledger_shard_reserved_leftover",
+    "ledger_shard_recovered_in_doubt", "ledger_shard_finalize_conflicts",
+    "cross_shard_abort_rate", "cross_shard_pct",
+    # host fingerprint: floors are fitted within a host class only
+    # (same_host_class) — a rate recorded on a big box is not a floor
+    # for a small one
+    "host_cpus",
 )
 
 #: required fields that are NOT numbers (shape-checked individually)
@@ -400,6 +412,7 @@ _LEDGER_FIELD_TYPES: dict = {
     "ledger_critpath_dominant_issue": str,
     "ledger_critpath_dominant_pay": str,
     "ledger_critpath_dominant_settle": str,
+    "ledger_shard_commit_counts": dict,
 }
 
 #: per-class tolerance for the blame-conservation probe: the p50
@@ -460,12 +473,29 @@ def ledger_schema_violations(current: dict) -> list[str]:
     return problems
 
 
-def fit_ledger_guards(trajectory: list[dict]) -> dict:
+def same_host_class(run: dict, reference: dict | None) -> bool:
+    """True when ``run`` was recorded on the same host class as
+    ``reference``. The open-loop ledger numbers are host-shaped — a
+    committed rate or a per-class e2e p99 recorded on a 16-core box is
+    not a floor a 1-core box can be held to — so floors are fitted only
+    from trajectory rounds whose ``host_cpus`` matches the current run's.
+    Rounds predating the field (both sides absent → equal) stay mutually
+    comparable, so pre-field trajectories keep guarding each other."""
+    if reference is None:
+        return True
+    return run.get("host_cpus") == reference.get("host_cpus")
+
+
+def fit_ledger_guards(trajectory: list[dict],
+                      reference: dict | None = None) -> dict:
     """Best-so-far guards over the full-run LEDGER entries (smoke rounds
-    contribute nothing; zero values mean the stage never ran)."""
+    contribute nothing; zero values mean the stage never ran; rounds from
+    a different host class — see ``same_host_class`` — contribute
+    nothing either)."""
     guards: dict = {}
     for run in trajectory:
-        if run is None or run.get("smoke"):
+        if run is None or run.get("smoke") \
+                or not same_host_class(run, reference):
             continue
         for name, (direction, tol) in LEDGER_GUARDED.items():
             v = run.get(name)
@@ -501,7 +531,119 @@ def guard_ledger(current: dict,
     for path in sorted(paths):
         with open(path, encoding="utf-8") as f:
             runs.append(parse_artifact(json.load(f)))
-    for name, g in sorted(fit_ledger_guards(runs).items()):
+    for name, g in sorted(fit_ledger_guards(runs, reference=current).items()):
+        v = current.get(name)
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            continue
+        if g["direction"] == "higher" and v < g["bound"]:
+            problems.append(
+                f"{name}: {v:g} < floor {g['bound']:.4g} "
+                f"(best {g['best']:g} - {g['tolerance']:.0%} tolerance; "
+                f"higher is better)")
+        elif g["direction"] == "lower" and v > g["bound"]:
+            problems.append(
+                f"{name}: {v:g} > ceiling {g['bound']:.4g} "
+                f"(best {g['best']:g} + {g['tolerance']:.0%} tolerance; "
+                f"lower is better)")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# SHARD-SCALING gate (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+#: Fields a sharded LEDGER artifact must carry on top of the LEDGER base:
+#: the measured tx/s-vs-shards curve (``shard_sweep`` is the list of
+#: per-shard-count saturation points) and its scalar summaries.
+SHARD_REQUIRED: tuple = (
+    "shard_sweep", "shard_scaling_x", "shard_scaling_efficiency_pct",
+    "shard_sweep_abort_rate", "ledger_shard_count",
+    "committed_tx_per_sec_shards_1",
+)
+
+#: scaling-curve locks: efficiency and the absolute ratio are floors
+#: (RATE_TOLERANCE, like fleet scaling_efficiency_pct); the sweep's
+#: aggregate abort rate (``shard_sweep_abort_rate`` — distinct from the
+#: flows scenario's ``cross_shard_abort_rate``, a different workload) is
+#: a ceiling with tail tolerance (it is a small number driven by the
+#: deliberate-conflict fraction, so it is noisy in relative terms).
+SHARD_GUARDED: dict = {
+    "shard_scaling_efficiency_pct": ("higher", RATE_TOLERANCE),
+    "shard_scaling_x": ("higher", RATE_TOLERANCE),
+    "shard_sweep_abort_rate": ("lower", TAIL_TOLERANCE),
+}
+
+
+def guard_shards(current: dict,
+                 trajectory_paths: list[str] | None = None) -> list[str]:
+    """The shard-scaling gate (bench.py --ledger). Schema always; HARD
+    safety invariants regardless of smoke (every sweep point holds
+    exactly-once + replica agreement + zero leftover reservations, and
+    multi-shard points committed real cross-shard transactions); full
+    runs additionally hold the curve floors fit from LEDGER trajectory
+    rounds that carry the fields (pre-r04 rounds contribute nothing)."""
+    current = parse_artifact(current)
+    problems = []
+    for name in SHARD_REQUIRED:
+        if name not in current:
+            problems.append(f"missing required shard field {name!r}")
+    if problems:
+        return problems
+    sweep = current["shard_sweep"]
+    if not isinstance(sweep, list) or not sweep:
+        return ["shard_sweep should be a non-empty list"]
+    cross_total = 0
+    for p in sweep:
+        if not isinstance(p, dict):
+            return ["shard_sweep entries should be dicts"]
+        tag = f"shard_sweep[shards={p.get('shards')}]"
+        if not p.get("exactly_once_ok"):
+            problems.append(f"{tag}: exactly_once_ok is false")
+        if not p.get("replicas_agree"):
+            problems.append(f"{tag}: replicas_agree is false")
+        if p.get("reserved_leftover", 0) != 0:
+            problems.append(
+                f"{tag}: reserved_leftover="
+                f"{p.get('reserved_leftover')} (refs left reserved)")
+        if p.get("shards", 1) > 1:
+            cross_total += int(p.get("cross_shard_committed", 0) or 0)
+    if len(sweep) > 1 and cross_total < 1:
+        problems.append("no cross-shard transaction committed anywhere "
+                        "in the multi-shard sweep")
+    if current.get("smoke"):
+        return problems
+    paths = (ledger_trajectory_paths() if trajectory_paths is None
+             else trajectory_paths)
+    runs = []
+    for path in sorted(paths):
+        with open(path, encoding="utf-8") as f:
+            runs.append(parse_artifact(json.load(f)))
+    guarded = dict(SHARD_GUARDED)
+    # per-shard-count committed rates are floors too, for exactly the
+    # counts the current sweep measured
+    for p in sweep:
+        guarded[f"committed_tx_per_sec_shards_{p.get('shards')}"] = \
+            ("higher", RATE_TOLERANCE)
+    guards: dict = {}
+    for run in runs:
+        if run is None or run.get("smoke") \
+                or not same_host_class(run, current):
+            continue
+        for name, (direction, tol) in guarded.items():
+            v = run.get(name)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v <= 0:
+                continue
+            g = guards.get(name)
+            best = v if g is None else (
+                max(g["best"], v) if direction == "higher"
+                else min(g["best"], v))
+            guards[name] = {
+                "best": best,
+                "bound": best * (1 - tol) if direction == "higher"
+                         else best * (1 + tol),
+                "direction": direction, "tolerance": tol}
+    for name, g in sorted(guards.items()):
         v = current.get(name)
         if not isinstance(v, (int, float)) or isinstance(v, bool):
             continue

@@ -43,7 +43,11 @@ FAMILIES = {
                 # tail forensics (rounds before r03 render "-")
                 "ledger_critpath_dominant_issue",
                 "ledger_critpath_dominant_pay",
-                "ledger_critpath_dominant_settle")),
+                "ledger_critpath_dominant_settle",
+                # shard scaling (rounds before r04 render "-")
+                "ledger_shard_count",
+                "shard_scaling_efficiency_pct",
+                "shard_sweep_abort_rate")),
 }
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")

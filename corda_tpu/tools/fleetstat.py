@@ -132,6 +132,23 @@ def render(fleet: dict, metrics: dict, critpath: dict | None = None) -> str:
                     + (f"({a.get('step') or a.get('worker')})"
                        if (a.get('step') or a.get('worker')) else "")
                     for a in tail))
+    # sharded-notary commit counts (ISSUE 15): per-shard labeled meters
+    # ``GroupCommit.Committed{shard="s0"}``. Pre-shard nodes expose only
+    # the unlabeled family — render "-" so an operator sees the surface
+    # exists but carries no per-shard split (the benchtrend "-" stance).
+    shard_cells = []
+    for key in sorted(k for k in metrics
+                      if isinstance(k, str)
+                      and k.startswith('GroupCommit.Committed{shard="')):
+        fields = metrics.get(key)
+        c = fields.get("count") if isinstance(fields, dict) else None
+        label = key[len('GroupCommit.Committed{shard="'):].rstrip('"}')
+        shard_cells.append(
+            f"{label}={int(c) if isinstance(c, (int, float)) and not isinstance(c, bool) else '-'}")
+    if shard_cells:
+        lines.append("shard commits: " + "  ".join(shard_cells))
+    elif isinstance(metrics.get("GroupCommit.Committed"), dict):
+        lines.append("shard commits: -")
     per_class = critpath.get("per_class") if isinstance(critpath, dict) \
         else None
     if isinstance(per_class, dict) and per_class:

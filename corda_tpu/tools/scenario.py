@@ -36,6 +36,15 @@ def build_config(argv=None):
                          "exchange-like party, then a deliberate "
                          "double-spend replay burst (combine with --full "
                          "for the measured shape)")
+    ap.add_argument("--shards", type=int, default=None,
+                    help="sharded-notary preset: partition the uniqueness "
+                         "domain over N raft groups with a cross-shard "
+                         "payment mix (combine with --full for the "
+                         "measured shape)")
+    ap.add_argument("--cross-shard-pct", type=float, default=None,
+                    help="fraction of payments forced multi-coin so their "
+                         "inputs straddle shards (default 0.35 with "
+                         "--shards)")
     ap.add_argument("--parties", type=int, default=None)
     ap.add_argument("--ops", type=int, default=None,
                     help="total operations (issue ops included)")
@@ -46,7 +55,14 @@ def build_config(argv=None):
                     help="uniqueness-provider commit timeout (seconds)")
     args = ap.parse_args(argv)
 
-    if args.hot_state:
+    if args.shards is not None and args.shards > 1:
+        cfg = LedgerScenarioConfig.sharded(
+            shards=args.shards,
+            cross_shard_pct=(args.cross_shard_pct
+                             if args.cross_shard_pct is not None else 0.35),
+            full=args.full)
+        cfg.chaos = args.chaos
+    elif args.hot_state:
         cfg = LedgerScenarioConfig.hot_state(full=args.full)
         cfg.chaos = args.chaos
     elif args.full:
@@ -77,6 +93,12 @@ def main(argv=None) -> int:
         # the hot vault still committed real throughput
         ok = ok and report["double_spend_rejection_rate"] == 1.0 \
             and report["committed_tx_per_sec"] > 0
+    if report.get("ledger_shard_count", 1) > 1:
+        # the sharded gate: exactly-once held across shards (base ok
+        # already covers it), the cross-shard 2PC path actually committed
+        # work, and no reservation outlived the run
+        ok = ok and report.get("ledger_shard_cross_committed", 0) > 0 \
+            and report.get("ledger_shard_reserved_leftover", 0) == 0
     return 0 if ok else 1
 
 

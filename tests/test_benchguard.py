@@ -252,6 +252,18 @@ def _ledger(**over):
         "ledger_critpath_blame_p99_settle": {"notary.batch_wait": 1500.0},
         "ledger_critpath_e2e_p50_ms_settle": 500.0,
         "ledger_critpath_dominant_settle": "notary.batch_wait",
+        # sharded-notary fields (ISSUE 15)
+        "ledger_shard_count": 2,
+        "ledger_shard_commit_counts": {"s0": 340, "s1": 326},
+        "ledger_shard_cross_committed": 60,
+        "ledger_shard_cross_aborted": 2,
+        "ledger_shard_cross_recovered": 0,
+        "ledger_shard_reserved_leftover": 0,
+        "ledger_shard_recovered_in_doubt": 0,
+        "ledger_shard_finalize_conflicts": 0,
+        "cross_shard_abort_rate": 0.032,
+        "cross_shard_pct": 0.15,
+        "host_cpus": 8,
     }
     base.update(over)
     return base
@@ -316,6 +328,34 @@ def test_ledger_smoke_gets_schema_check_only(tmp_path):
     assert benchguard.guard_ledger(smoke, [str(fast)]) == []
 
 
+def test_ledger_floors_fit_within_host_class_only(tmp_path):
+    """Floors recorded on a bigger box are not held against a smaller
+    one: trajectory rounds with a different host_cpus contribute no
+    floors, same-class rounds do, and rounds predating the field (both
+    sides absent) keep guarding each other."""
+    big = tmp_path / "LEDGER_r01.json"
+    big.write_text(json.dumps(_ledger(committed_tx_per_sec=100.0,
+                                      host_cpus=64)))
+    # a 64-core round sets no floor for an 8-core run
+    assert benchguard.guard_ledger(
+        _ledger(committed_tx_per_sec=10.0), [str(big)]) == []
+    # a same-class round still does
+    peer = tmp_path / "LEDGER_r02.json"
+    peer.write_text(json.dumps(_ledger(committed_tx_per_sec=20.0)))
+    problems = benchguard.guard_ledger(
+        _ledger(committed_tx_per_sec=10.0), [str(big), str(peer)])
+    assert any("committed_tx_per_sec" in p for p in problems)
+    # pre-field rounds (no host_cpus on either side) stay comparable
+    legacy = _ledger(committed_tx_per_sec=20.0)
+    legacy.pop("host_cpus")
+    old = tmp_path / "LEDGER_r03.json"
+    old.write_text(json.dumps(legacy))
+    cur = _ledger(committed_tx_per_sec=10.0)
+    cur.pop("host_cpus")
+    problems = benchguard.guard_ledger(cur, [str(old)])
+    assert any("committed_tx_per_sec" in p for p in problems)
+
+
 def test_ledger_critpath_blame_conservation_probe(tmp_path):
     # the helper's vectors sum exactly to their e2e: clean
     assert benchguard.ledger_critpath_violations(_ledger()) == []
@@ -344,3 +384,87 @@ def test_ledger_real_artifact_passes_self_replay():
     with open(sorted(paths)[-1], encoding="utf-8") as f:
         latest = json.load(f)
     assert benchguard.guard_ledger(latest, paths) == []
+
+
+# ---------------------------------------------------------------------------
+# SHARD-SCALING gate
+
+
+def _sweep_point(shards, rate, **over):
+    base = {
+        "shards": shards, "committed_tx_per_sec": rate,
+        "exactly_once_ok": True, "replicas_agree": True,
+        "reserved_leftover": 0,
+        "cross_shard_committed": 0 if shards == 1 else 12,
+        "cross_shard_aborted": 0 if shards == 1 else 1,
+    }
+    base.update(over)
+    return base
+
+
+def _sharded(**over):
+    points = [_sweep_point(1, 700.0), _sweep_point(2, 1300.0),
+              _sweep_point(4, 2300.0)]
+    base = _ledger(
+        shard_sweep=points,
+        committed_tx_per_sec_shards_1=700.0,
+        committed_tx_per_sec_shards_2=1300.0,
+        committed_tx_per_sec_shards_4=2300.0,
+        shard_scaling_x=2300.0 / 700.0,
+        shard_scaling_efficiency_pct=100.0 * (2300.0 / 700.0) / 4,
+        shard_sweep_abort_rate=0.032,
+        shard_sweep_ok=True)
+    base.update(over)
+    return base
+
+
+def test_shard_guard_schema_and_hard_invariants():
+    assert benchguard.guard_shards(_sharded(), []) == []
+    # every required scaling field is locked in
+    for field in benchguard.SHARD_REQUIRED:
+        broken = _sharded()
+        del broken[field]
+        assert benchguard.guard_shards(broken, []), field
+    # safety invariants are HARD — smoke does not excuse them
+    bad = _sharded(smoke=True)
+    bad["shard_sweep"] = [_sweep_point(1, 700.0),
+                          _sweep_point(2, 1300.0, exactly_once_ok=False)]
+    assert any("exactly_once_ok" in p
+               for p in benchguard.guard_shards(bad, []))
+    leak = _sharded(smoke=True)
+    leak["shard_sweep"][2]["reserved_leftover"] = 3
+    assert any("reserved_leftover" in p
+               for p in benchguard.guard_shards(leak, []))
+    # a multi-shard sweep that never committed cross-shard is a breach
+    no_cross = _sharded(smoke=True)
+    for p in no_cross["shard_sweep"]:
+        p["cross_shard_committed"] = 0
+    assert any("cross-shard" in p
+               for p in benchguard.guard_shards(no_cross, []))
+
+
+def test_shard_guard_locks_scaling_floors(tmp_path):
+    good = tmp_path / "LEDGER_r04.json"
+    good.write_text(json.dumps(_sharded()))
+    # scaling efficiency collapse breaches its floor
+    worse = _sharded(shard_scaling_efficiency_pct=
+                     100.0 * (2300.0 / 700.0) / 4 * (1 - 0.16))
+    assert any("shard_scaling_efficiency_pct" in p
+               for p in benchguard.guard_shards(worse, [str(good)]))
+    # a per-shard-count committed-rate collapse names its count
+    slow4 = _sharded(committed_tx_per_sec_shards_4=2300.0 * (1 - 0.16))
+    assert any("committed_tx_per_sec_shards_4" in p
+               for p in benchguard.guard_shards(slow4, [str(good)]))
+    # sweep abort-rate blowup breaches the ceiling (tail tolerance 0.5);
+    # the guarded field is the SWEEP aggregate, not the flows scenario's
+    # cross_shard_abort_rate (a different workload sharing the artifact)
+    aborts = _sharded(shard_sweep_abort_rate=0.032 * 1.6)
+    assert any("shard_sweep_abort_rate" in p
+               for p in benchguard.guard_shards(aborts, [str(good)]))
+    # within tolerance passes; smoke gets invariants only, no floors
+    assert benchguard.guard_shards(
+        _sharded(committed_tx_per_sec_shards_4=2100.0,
+                 shard_scaling_x=3.0), [str(good)]) == []
+    assert benchguard.guard_shards(
+        _sharded(smoke=True, committed_tx_per_sec_shards_4=10.0),
+        [str(good)]) == []

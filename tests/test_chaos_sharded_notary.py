@@ -1,0 +1,506 @@
+"""Sharded-notary chaos tests: cross-shard 2PC safety under conflict
+races and coordinator crashes.
+
+The ShardedUniquenessProvider partitions the uniqueness domain over N
+raft groups and runs a two-phase provisional commit for transactions
+whose inputs straddle shards. The properties under test:
+
+- a SAME-SHARD conflicting pair rides the untouched group-commit fast
+  path and resolves exactly-once on the home shard;
+- a CROSS-SHARD conflicting pair racing with their input lists in
+  opposite orders still contends at the canonical (lowest common) shard
+  — exactly one wins, the loser's reservations on other shards are
+  released, and an honest retry of the released ref succeeds;
+- a COORDINATOR KILLED between prepare and finalize leaves reservations
+  in-doubt; replaying the durable decision record into a fresh
+  coordinator resolves them — finalized when the decision reached
+  "commit", released otherwise — so no ref stays permanently reserved
+  and every replica of every shard converges.
+"""
+import threading
+import time
+
+import pytest
+
+from corda_tpu.consensus.raft import LEADER
+from corda_tpu.consensus.raft_uniqueness import (DistributedImmutableMap,
+                                                 RaftUniquenessProvider)
+from corda_tpu.consensus.sharded_uniqueness import (CoordinatorLog,
+                                                    CrossShardAtomicityError,
+                                                    ShardedUniquenessProvider,
+                                                    shard_of)
+from corda_tpu.core.contracts.structures import StateRef
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.network.inmemory import InMemoryMessagingNetwork
+from corda_tpu.node.notary import UniquenessException
+from corda_tpu.testing.faults import FaultError, FaultRule, inject
+
+pytestmark = pytest.mark.chaos
+
+SEEDS = [7, 101, 9001]
+N_SHARDS = 2
+
+
+class _ShardedCluster:
+    """N shards x 3 replicas on one in-memory bus, pumped from a
+    background thread (committers and the 2PC pool block on futures, so
+    synchronous pumping deadlocks)."""
+
+    def __init__(self, seed: int, n_shards: int = N_SHARDS,
+                 replicas: int = 3):
+        self.bus = InMemoryMessagingNetwork()
+        self.n_shards = n_shards
+        self.names = [[f"s{s}r{i}" for i in range(replicas)]
+                      for s in range(n_shards)]
+        self.maps = [[DistributedImmutableMap() for _ in range(replicas)]
+                     for _ in range(n_shards)]
+        self.providers = [
+            [RaftUniquenessProvider.build(
+                name, list(self.names[s]), self.bus.create_node(name),
+                state_machine=self.maps[s][i], seed=seed + 31 * s + i,
+                native=False)
+             for i, name in enumerate(self.names[s])]
+            for s in range(n_shards)]
+        self.nodes = [[p.raft for p in group] for group in self.providers]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._pump, daemon=True,
+                                        name="chaos-shard-pump")
+        self._thread.start()
+
+    def _pump(self):
+        while not self._stop.is_set():
+            for group in self.nodes:
+                for rn in group:
+                    rn.tick()
+            for group in self.names:
+                for name in group:
+                    while self.bus.pump_receive(name) is not None:
+                        pass
+            time.sleep(0.002)
+
+    def wait_leaders(self, timeout=20.0):
+        """One entry provider per shard, each backed by its shard's
+        elected leader."""
+        entries = []
+        deadline = time.monotonic() + timeout
+        for s in range(self.n_shards):
+            while time.monotonic() < deadline:
+                leaders = [i for i, n in enumerate(self.nodes[s])
+                           if n.role == LEADER]
+                if len(leaders) == 1:
+                    entries.append(self.providers[s][leaders[0]])
+                    break
+                time.sleep(0.01)
+            else:
+                raise AssertionError(f"shard {s}: no leader elected")
+        return entries
+
+    def build_provider(self, log_path=None, timeout_s=10.0):
+        return ShardedUniquenessProvider(
+            self.wait_leaders(), timeout_s=timeout_s,
+            decision_log=CoordinatorLog(log_path))
+
+    def reserved_total(self) -> int:
+        return sum(len(m._reserved)
+                   for group in self.maps for m in group)
+
+    def wait_shards_converged(self, timeout=10.0):
+        """Every replica of every shard agrees ref-for-ref with its
+        group AND carries zero leftover reservations."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ok = all(m._map == group[0]._map for group in self.maps
+                     for m in group)
+            if ok and self.reserved_total() == 0:
+                return
+            time.sleep(0.01)
+        raise AssertionError(
+            "shards did not converge reservation-free: "
+            f"sizes={[[len(m) for m in g] for g in self.maps]} "
+            f"reserved={self.reserved_total()}")
+
+    def owner_of(self, ref):
+        """The consuming tx recorded on the ref's home shard (leader's
+        map), or None."""
+        s = shard_of(ref, self.n_shards)
+        held = self.maps[s][0]._map.get(ref)
+        return held.consuming_tx if held is not None else None
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def _ref_on(shard: int, tag: str, n_shards: int = N_SHARDS) -> StateRef:
+    """Rejection-sample a StateRef whose shard_of bucket is `shard`."""
+    i = 0
+    while True:
+        ref = StateRef(SecureHash.sha256(f"{tag}:{i}".encode()), 0)
+        if shard_of(ref, n_shards) == shard:
+            return ref
+        i += 1
+
+
+def _tx(tag: str):
+    return SecureHash.sha256(b"tx:" + tag.encode())
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_same_shard_conflict_one_winner(seed):
+    """Two spends of one ref whose inputs live entirely on shard 0: both
+    take the single-shard group-commit fast path — exactly one wins, the
+    loser's conflict names the winner, every replica of the home shard
+    records the same owner, and shard 1 never hears about it."""
+    cluster = _ShardedCluster(seed)
+    provider = None
+    try:
+        provider = cluster.build_provider()
+        ref = _ref_on(0, f"same-{seed}")
+        f_a = provider.commit_async([ref], _tx("a"), "chaos")
+        f_b = provider.commit_async([ref], _tx("b"), "chaos")
+        outcomes = {}
+        for name, fut in (("a", f_a), ("b", f_b)):
+            try:
+                fut.result(timeout=15)
+                outcomes[name] = "committed"
+            except UniquenessException as ei:
+                assert ref in ei.conflicts
+                outcomes[name] = "rejected"
+        winners = [n for n, o in outcomes.items() if o == "committed"]
+        assert len(winners) == 1, outcomes
+        cluster.wait_shards_converged()
+        assert cluster.owner_of(ref) == _tx(winners[0])
+        assert all(len(m) == 0 for m in cluster.maps[1])
+    finally:
+        if provider is not None:
+            provider.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_cross_shard_conflict_racing_both_orders(seed):
+    """Two cross-shard transactions racing for one shared ref on shard 1,
+    their input lists given in OPPOSITE orders. Canonical shard-order
+    preparation makes both reserve their private shard-0 ref first, then
+    contend at shard 1: exactly one wins, the loser's shard-0
+    reservation is released (an honest retry of that ref succeeds), and
+    no ref is left reserved anywhere."""
+    cluster = _ShardedCluster(seed)
+    provider = None
+    try:
+        provider = cluster.build_provider()
+        a_only = _ref_on(0, f"xa-{seed}")
+        b_only = _ref_on(0, f"xb-{seed}")
+        shared = _ref_on(1, f"xs-{seed}")
+        tx_a, tx_b = _tx(f"xa-{seed}"), _tx(f"xb-{seed}")
+        # a lists low shard first, b lists high shard first — partition()
+        # canonicalizes, so the race is order-independent by construction
+        f_a = provider.commit_async([a_only, shared], tx_a, "chaos")
+        f_b = provider.commit_async([shared, b_only], tx_b, "chaos")
+        outcomes = {}
+        for name, fut in (("a", f_a), ("b", f_b)):
+            try:
+                fut.result(timeout=20)
+                outcomes[name] = "committed"
+            except UniquenessException as ei:
+                assert shared in ei.conflicts
+                outcomes[name] = "rejected"
+        winners = [n for n, o in outcomes.items() if o == "committed"]
+        assert len(winners) == 1, outcomes
+        win_tx = tx_a if winners[0] == "a" else tx_b
+        loser_ref = b_only if winners[0] == "a" else a_only
+
+        cluster.wait_shards_converged()
+        assert cluster.owner_of(shared) == win_tx
+        # the loser's private ref was reserved in phase 1 and must have
+        # been RELEASED by the abort: an honest retry spends it cleanly
+        assert cluster.owner_of(loser_ref) is None
+        retry_tx = _tx(f"retry-{seed}")
+        assert provider.commit_async([loser_ref], retry_tx,
+                                     "chaos").result(timeout=15) is None
+        cluster.wait_shards_converged()
+        assert cluster.owner_of(loser_ref) == retry_tx
+        snap = provider.metrics.snapshot()
+        assert snap["CrossShard.Aborted"]["count"] >= 1
+        assert snap["CrossShard.Committed"]["count"] == 1
+    finally:
+        if provider is not None:
+            provider.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_coordinator_killed_after_decide_recovers_to_commit(seed, tmp_path):
+    """Coordinator killed between the durable "commit" decision and
+    finalize: the refs sit reserved (in-doubt) and NO inline cleanup
+    runs — the process is "dead". Replaying the decision file into a
+    fresh coordinator finalizes the transaction on every shard; the
+    once-in-doubt refs end up consumed exactly-once, nothing stays
+    reserved."""
+    cluster = _ShardedCluster(seed)
+    provider = recovered = None
+    log_path = str(tmp_path / "decisions.log")
+    try:
+        provider = cluster.build_provider(log_path=log_path)
+        refs = [_ref_on(0, f"kc-{seed}"), _ref_on(1, f"kc-{seed}")]
+        tx = _tx(f"kc-{seed}")
+        with inject(FaultRule("shard2pc.finalize", "raise", count=1),
+                    seed=seed):
+            with pytest.raises(FaultError):
+                provider.commit(refs, tx, "chaos")
+        # the crash left the tx in-doubt with a durable commit decision
+        # (each shard's leader holds a reservation; followers follow)
+        assert provider.log.status(tx) == "commit"
+        assert cluster.reserved_total() >= len(refs)
+
+        # "restart": a fresh coordinator replays the decision file
+        recovered = ShardedUniquenessProvider(
+            cluster.wait_leaders(), timeout_s=10.0,
+            decision_log=CoordinatorLog(log_path))
+        assert recovered.log.status(tx) == "commit"
+        resolved = recovered.recover_in_doubt()
+        assert resolved == [(tx, "committed")]
+
+        cluster.wait_shards_converged()
+        for ref in refs:
+            assert cluster.owner_of(ref) == tx
+        assert len(recovered.log) == 0
+        # a double spend of a recovered ref still rejects exactly-once
+        with pytest.raises(UniquenessException):
+            recovered.commit([refs[0]], _tx(f"dup-{seed}"), "chaos")
+    finally:
+        if recovered is not None:
+            recovered.close()
+        if provider is not None:
+            provider.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_abort_releases_shard_whose_prepare_timed_out(seed):
+    """The late-commit race: the reserve round on shard 1 SUCCEEDS on the
+    replicated state machine but the coordinator sees a timeout (the
+    _RoundStuck scenario — the verdict never came back). The abort must
+    release shard 1's reservation anyway, not just the shards whose
+    reserve verdict it saw, or the ref stays reserved forever and every
+    future spender gets a false double-spend conflict."""
+    cluster = _ShardedCluster(seed)
+    provider = None
+    try:
+        provider = cluster.build_provider()
+        refs = [_ref_on(0, f"to-{seed}"), _ref_on(1, f"to-{seed}")]
+        tx = _tx(f"to-{seed}")
+        orig = provider._round
+        fired = []
+
+        def flaky(shard, command, trace_ctx, phase, n_states):
+            out = orig(shard, command, trace_ctx, phase, n_states)
+            if phase == "prepare" and shard == 1 and not fired:
+                fired.append(shard)   # reservation IS taken; verdict lost
+                raise TimeoutError("injected: prepare verdict lost")
+            return out
+
+        provider._round = flaky
+        with pytest.raises(TimeoutError):
+            provider.commit(refs, tx, "chaos")
+        # abort released BOTH shards' reservations and retired the entry
+        cluster.wait_shards_converged()
+        assert len(provider.log) == 0
+        for ref in refs:
+            assert cluster.owner_of(ref) is None
+        # an honest retry of the once-stranded refs commits cleanly
+        retry_tx = _tx(f"to-retry-{seed}")
+        provider.commit(refs, retry_tx, "chaos")
+        cluster.wait_shards_converged()
+        for ref in refs:
+            assert cluster.owner_of(ref) == retry_tx
+    finally:
+        if provider is not None:
+            provider.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS[:1])
+def test_finalize_conflict_is_surfaced_and_left_in_doubt(seed):
+    """A lost reservation (here: a zombie release plus a rival spend
+    sneaking in between decide and finalize) makes finalize_all report a
+    conflict verdict. The coordinator must NOT count the tx committed or
+    complete its log entry — it surfaces CrossShardAtomicityError, marks
+    the alert meter, and recovery keeps the entry in-doubt instead of
+    resolving the violation silently."""
+    cluster = _ShardedCluster(seed)
+    provider = None
+    try:
+        provider = cluster.build_provider()
+        r0, r1 = _ref_on(0, f"fc-{seed}"), _ref_on(1, f"fc-{seed}")
+        tx, rival = _tx(f"fc-{seed}"), _tx(f"fc-rival-{seed}")
+        orig = provider._round
+        stolen = []
+
+        def stealing(shard, command, trace_ctx, phase, n_states):
+            if phase == "finalize" and shard == 1 and not stolen:
+                stolen.append(shard)
+                # zombie recovery releases tx's reservation, rival spends
+                orig(1, ("release_all", (tx, [r1])), None, "release", 1)
+                orig(1, ("put_all", (rival, [r1], "rival")), None,
+                     "steal", 1)
+            return orig(shard, command, trace_ctx, phase, n_states)
+
+        provider._round = stealing
+        with pytest.raises(CrossShardAtomicityError) as ei:
+            provider.commit([r0, r1], tx, "chaos")
+        assert r1 in ei.value.conflicts
+        assert ei.value.conflicts[r1].consuming_tx == rival
+        # the entry is still in-doubt with its durable commit decision —
+        # NOT completed as if the tx had committed atomically
+        assert provider.log.status(tx) == "commit"
+        snap = provider.metrics.snapshot()
+        assert snap["CrossShard.FinalizeConflict"]["count"] == 1
+        assert (snap.get("CrossShard.Committed") or {}).get("count", 0) == 0
+        # recovery does not silently resolve it either: the entry stays
+        # in-doubt and the meter keeps alerting
+        provider._round = orig
+        assert provider.recover_in_doubt() == []
+        assert provider.log.status(tx) == "commit"
+        assert provider.metrics.snapshot()[
+            "CrossShard.FinalizeConflict"]["count"] == 2
+    finally:
+        if provider is not None:
+            provider.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_fast_path_spend_of_reserved_ref_defers_until_release(seed):
+    """A single-shard spend of a ref provisionally reserved by an
+    in-flight cross-shard 2PC must DEFER, not terminal-reject: the
+    reservation is revocable, and when the holder aborts and releases,
+    the parked spend gets its chance and commits — previously the client
+    received a permanent double-spend error for an unspent state."""
+    cluster = _ShardedCluster(seed)
+    provider = None
+    prepared, proceed = threading.Event(), threading.Event()
+    try:
+        provider = cluster.build_provider()
+        shared = _ref_on(0, f"dv-{seed}")
+        other = _ref_on(1, f"dv-{seed}")
+        tx_a, tx_b = _tx(f"dv-a-{seed}"), _tx(f"dv-b-{seed}")
+        orig = provider._round
+
+        def holding(shard, command, trace_ctx, phase, n_states):
+            out = orig(shard, command, trace_ctx, phase, n_states)
+            if phase == "prepare" and shard == 1:
+                prepared.set()          # both shards now hold reservations
+                proceed.wait(timeout=15)
+                raise TimeoutError("injected: coordinator gives up")
+            return out
+
+        provider._round = holding
+        f_a = provider.commit_async([shared, other], tx_a, "chaos")
+        assert prepared.wait(timeout=15)
+        # B spends the reserved ref on the fast path: parked, not rejected
+        f_b = provider.commit_async([shared], tx_b, "chaos")
+        time.sleep(0.4)
+        assert not f_b.done(), \
+            "fast-path spend of a reserved ref must defer, not resolve"
+        proceed.set()                   # A aborts and releases both shards
+        with pytest.raises(TimeoutError):
+            f_a.result(timeout=15)
+        # the released ref's parked spender commits (ticker re-screen —
+        # no further batch completion happens on that shard by itself)
+        assert f_b.result(timeout=15) is None
+        cluster.wait_shards_converged()
+        assert cluster.owner_of(shared) == tx_b
+        assert cluster.owner_of(other) is None
+    finally:
+        prepared.set()
+        proceed.set()
+        if provider is not None:
+            provider.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS[:1])
+def test_provisional_batch_verdict_reparks_instead_of_rejecting(seed):
+    """If a reservation lands between the committer's prescreen and the
+    replicated apply, the batch verdict comes back conflict-but-
+    provisional. The committer must re-park the request (it is blocked
+    by a revocable claim) rather than fail the future terminally."""
+    cluster = _ShardedCluster(seed)
+    provider = None
+    try:
+        provider = cluster.build_provider()
+        ref = _ref_on(0, f"pv-{seed}")
+        holder, spender = _tx(f"pv-hold-{seed}"), _tx(f"pv-spend-{seed}")
+        shard0 = provider.shards[0]
+        # take a real replicated reservation on shard 0
+        out = shard0.raft.submit(
+            ("reserve_all", (holder, [ref], "holder"))).result(timeout=15)
+        assert out["committed"]
+        # warm up the committer on an unrelated tx, then blind its
+        # reservation prescreen so the spend reaches consensus and meets
+        # the reservation at apply time (the mid-flight race)
+        warm = _ref_on(0, f"pv-warm-{seed}")
+        provider.commit_async(
+            [warm], _tx(f"pv-warm-{seed}"), "chaos").result(timeout=15)
+        committer = shard0.group_committer
+        committer._reserved_view = lambda: {}
+        fut = provider.commit_async([ref], spender, "chaos")
+        time.sleep(0.4)
+        assert not fut.done(), \
+            "provisional conflict verdict must re-park, not reject"
+        # holder releases: the parked spend must now commit
+        out = shard0.raft.submit(
+            ("release_all", (holder, [ref]))).result(timeout=15)
+        assert out["committed"]
+        assert fut.result(timeout=15) is None
+        cluster.wait_shards_converged()
+        assert cluster.owner_of(ref) == spender
+    finally:
+        if provider is not None:
+            provider.close()
+        cluster.close()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_coordinator_killed_before_decide_recovers_to_abort(seed, tmp_path):
+    """Coordinator killed AFTER reserving on every shard but BEFORE the
+    decision reached the record: recovery must abort — the reservations
+    are released, the decision record drains, and an honest retry of the
+    same refs by a new transaction succeeds."""
+    cluster = _ShardedCluster(seed)
+    provider = recovered = None
+    log_path = str(tmp_path / "decisions.log")
+    try:
+        provider = cluster.build_provider(log_path=log_path)
+        refs = [_ref_on(0, f"ka-{seed}"), _ref_on(1, f"ka-{seed}")]
+        tx = _tx(f"ka-{seed}")
+        with inject(FaultRule("shard2pc.decide", "raise", count=1),
+                    seed=seed):
+            with pytest.raises(FaultError):
+                provider.commit(refs, tx, "chaos")
+        assert provider.log.status(tx) == "prepare"
+        assert cluster.reserved_total() >= len(refs)
+
+        recovered = ShardedUniquenessProvider(
+            cluster.wait_leaders(), timeout_s=10.0,
+            decision_log=CoordinatorLog(log_path))
+        resolved = recovered.recover_in_doubt()
+        assert resolved == [(tx, "aborted")]
+        cluster.wait_shards_converged()
+        for ref in refs:
+            assert cluster.owner_of(ref) is None
+        assert len(recovered.log) == 0
+
+        # honest retry: the released refs commit cleanly cross-shard
+        retry_tx = _tx(f"ka-retry-{seed}")
+        recovered.commit(refs, retry_tx, "chaos")
+        cluster.wait_shards_converged()
+        for ref in refs:
+            assert cluster.owner_of(ref) == retry_tx
+    finally:
+        if recovered is not None:
+            recovered.close()
+        if provider is not None:
+            provider.close()
+        cluster.close()
