@@ -593,6 +593,36 @@ def ledger_main() -> None:
                         "conflicted after the durable commit decision "
                         f"({out['ledger_shard_finalize_conflicts']} tx left "
                         "in-doubt)")
+    # consensus-observatory validity (ISSUE 16): the per-entry raft
+    # attribution must exist, the retained time-series plane must hold
+    # ≥ 2 downsampled resolutions of Raft.LogEntries, and the sweep must
+    # report a skew index. The attribution-sum conservation probe — the
+    # component sum's p50 within 10% of the measured round p50 — is
+    # enforced on FULL runs (hundreds of samples); under --smoke the
+    # nearest-rank p50 of ~15 bimodal samples quantizes too coarsely for
+    # a ratio test, so smoke only requires the fields to be live.
+    attrib_sum = out.get("ledger_raft_attrib_sum_ms_p50", 0.0)
+    round_p50 = out.get("ledger_raft_round_ms_p50", 0.0)
+    if out.get("ledger_raft_attrib_samples", 0) < 1 or attrib_sum <= 0.0:
+        problems.append("no raft commit-path attribution samples (the "
+                        "consensus observatory saw no committed entry)")
+    if round_p50 <= 0.0:
+        problems.append("no measured consensus-round samples "
+                        "(GroupCommitter.round_samples is empty)")
+    if not SMOKE and attrib_sum > 0.0 and round_p50 > 0.0:
+        rel = abs(attrib_sum - round_p50) / round_p50
+        if rel > 0.10:
+            problems.append(
+                "raft attribution broke conservation: component sum p50 "
+                f"{attrib_sum:.3f} ms vs measured round p50 "
+                f"{round_p50:.3f} ms ({rel:.1%} apart, tolerance 10%)")
+    if out.get("ledger_timeseries_resolutions", 0) < 2:
+        problems.append("retained time-series plane holds "
+                        f"{out.get('ledger_timeseries_resolutions', 0)} "
+                        "downsampled resolutions of Raft.LogEntries "
+                        "(want >= 2)")
+    if out.get("shard_sweep_skew_index", 0.0) <= 0.0:
+        problems.append("shard sweep reported no skew index")
     if problems:
         for p in problems:
             print(f"BENCH INVALID: {p}", file=sys.stderr)

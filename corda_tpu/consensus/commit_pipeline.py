@@ -52,6 +52,7 @@ from __future__ import annotations
 import concurrent.futures
 import threading
 import time as _time
+from collections import deque
 
 from ..node.notary import UniquenessException, find_conflicts
 from .provider import consensus_round
@@ -121,6 +122,12 @@ class GroupCommitter:
             if label else None)
 
         self._lock = threading.Lock()
+        # exact consensus-round durations (seconds), bounded. The same
+        # value feeds the raft_commit_seconds histogram; the exact list
+        # exists because the consensus-observatory validity probe compares
+        # the raft-side attribution sum against this measured round within
+        # 10% — inside the log-bucket histogram's quantile resolution.
+        self._round_samples: deque = deque(maxlen=4096)
         self._queue: list[_Req] = []
         self._pending: dict = {}        # ref -> tx_id claimed by queue/flight
         self._deferred: list = []       # (refs, tx_id, caller, ctx, fut, t)
@@ -278,6 +285,7 @@ class GroupCommitter:
         t0 = _time.perf_counter()
         results = None
         error = None
+        timing: dict = {}
         try:
             payload = [[r.tx_id, list(r.refs), r.caller] for r in reqs]
             out = consensus_round(
@@ -285,15 +293,26 @@ class GroupCommitter:
                 trace_ctx=sp.context() or first_ctx,
                 on_attempt=self._m_appends.mark,
                 site="raft.submit.group_commit",
-                attempt_timeout_s=self.attempt_timeout_s)
+                attempt_timeout_s=self.attempt_timeout_s,
+                timing=timing)
             results = out["results"]
         except BaseException as e:
             error = e
             sp.set_tag("error", f"{type(e).__name__}: {e}")
         finally:
             sp.finish()
-            self._raft_commit_hist.update(_time.perf_counter() - t0,
-                                          trace_id=trace_id)
+            # prefer the backend's resolution stamp: submit→resolve without
+            # this waiter thread's wakeup latency, matching what the raft
+            # side can attribute (the 10% conservation probe's comparison)
+            submit_p = timing.get("submit_perf")
+            resolved_p = timing.get("resolved_perf")
+            if isinstance(submit_p, float) and isinstance(resolved_p, float) \
+                    and resolved_p > submit_p:
+                round_s = resolved_p - submit_p
+            else:
+                round_s = _time.perf_counter() - t0
+            self._raft_commit_hist.update(round_s, trace_id=trace_id)
+            self._round_samples.append(round_s)
         self._finish_batch(reqs, results, error,
                            round_t0=round_t0, round_t1=_time.time())
 
@@ -371,6 +390,13 @@ class GroupCommitter:
                     "deferred": len(self._deferred),
                     "batches": self._n_batches,
                     "closed": self._closed}
+
+    def round_samples(self) -> list:
+        """Exact retained consensus-round durations (seconds, oldest
+        evicted at the cap) — the measured side of the consensus
+        observatory's attribution-conservation probe."""
+        with self._lock:
+            return list(self._round_samples)
 
     def close(self) -> None:
         """Flush whatever is queued, drain in-flight batches, and fail any

@@ -28,7 +28,8 @@ class _RoundStuck(concurrent.futures.TimeoutError):
 
 def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
                     on_attempt=None, site: str = "raft.submit",
-                    attempt_timeout_s: float | None = None):
+                    attempt_timeout_s: float | None = None,
+                    timing: dict | None = None):
     """One blocking replicated-state-machine round: submit ``command`` to
     `backend` (RaftNode or BFTClient), retrying leaderless windows with
     decorrelated-jitter backoff inside the timeout budget, abandoning the
@@ -42,7 +43,12 @@ def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
     ``attempt_timeout_s`` bounds ONE submit's wait: a round still pending
     after that long is abandoned and re-submitted (fresh leader lookup)
     instead of burning the whole ``timeout_s`` on an entry stranded on a
-    deposed leader. None keeps the single-wait behaviour."""
+    deposed leader. None keeps the single-wait behaviour.
+
+    ``timing`` (optional dict) receives the last attempt's exact clocks
+    when the backend stamps them: ``submit_perf`` (just before submit) and
+    ``resolved_perf`` (the backend's resolution stamp) — the consensus
+    observatory's waiter-wakeup-free round measurement."""
 
     def _submit(ctx):
         kwargs = {}
@@ -50,11 +56,19 @@ def consensus_round(backend, command, timeout_s: float, trace_ctx=None,
             kwargs["trace_ctx"] = ctx
         if on_attempt is not None:
             on_attempt()
+        if timing is not None:
+            timing["submit_perf"] = _time.perf_counter()
+            timing.pop("resolved_perf", None)
         fut = backend.submit(command, **kwargs)
         wait_s = timeout_s if attempt_timeout_s is None \
             else min(attempt_timeout_s, timeout_s)
         try:
-            return fut.result(timeout=wait_s)
+            result = fut.result(timeout=wait_s)
+            if timing is not None:
+                resolved = getattr(fut, "raft_resolved_perf", None)
+                if isinstance(resolved, float):
+                    timing["resolved_perf"] = resolved
+            return result
         except concurrent.futures.TimeoutError:
             backend.abandon(fut)
             if attempt_timeout_s is None:

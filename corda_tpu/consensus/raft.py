@@ -17,6 +17,8 @@ from __future__ import annotations
 import logging
 import random
 import threading
+import time as _time
+from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -84,6 +86,12 @@ class ClientRequest:
     request_id: int
     client: str
     entry: Any
+    #: the client's perf_counter at submit(), integer nanoseconds (the
+    #: codec bans floats in consensus data) — rides the forward hop so the
+    #: leader's append_wait attribution starts at the CLIENT's submit, not
+    #: at leader receipt (clocks are comparable: the framework runs every
+    #: node in one process; a cross-machine port must drop this field)
+    submit_perf_ns: int | None = None
 
 
 @dataclass(frozen=True)
@@ -92,6 +100,11 @@ class ClientResponse:
     result: Any = None
     error: str | None = None
     leader_hint: str | None = None
+    #: the leader's perf_counter at apply-end, integer nanoseconds — the
+    #: client resolves its round measurement against this stamp so the
+    #: response delivery hop cancels out of submit→resolve, matching what
+    #: attribution can see
+    resolved_perf_ns: int | None = None
 
 
 for _cls in (LogEntry, RequestVote, VoteResponse, AppendEntries,
@@ -154,8 +167,31 @@ class RaftNode:
         # timer thread, messages from the transport thread, and submits from
         # flow threads all mutate the same state.
         self._lock = threading.RLock()
+        # -- introspection state (consensus observatory; all under _lock) --
+        # submit-time clock per locally-submitted request: (client, rid) ->
+        # (perf_t0, epoch_t0); consumed when the leader appends the entry.
+        self._submit_clock: dict = {}
+        # per-appended-entry clock on the LEADER: (term, index) ->
+        # [perf_t0, epoch_t0, perf_append, perf_fsync_end]; popped at apply.
+        self._entry_clock: dict = {}
+        # bounded exact samples per commit-path component (seconds). Exact
+        # lists, not histograms: the bench validity probe compares the
+        # attribution sum against the measured round within 10%, far inside
+        # the log-bucket histogram's quantile resolution.
+        self._attrib: dict = {
+            k: deque(maxlen=self.ATTRIB_SAMPLE_CAP)
+            for k in ("append_wait", "fsync", "replicate", "apply", "total")}
+        self._elections: deque = deque(maxlen=64)   # episode dicts
+        self._elections_total = 0
+        self._election_started = None    # (perf_t0, epoch_t0, tick0, cause)
+        self._leader_since = None        # (perf_t, epoch_t) while LEADER
+        self._leader_tenure_last_s = 0.0
+        self._leader_tenure_total_s = 0.0
         self._registration = messaging.add_message_handler(
             TopicSession(TOPIC_RAFT), self._on_message)
+
+    #: exact attribution samples retained per component (oldest evicted)
+    ATTRIB_SAMPLE_CAP = 4096
 
     def stop(self) -> None:
         """Detach from the transport (restart/teardown path: a revived
@@ -203,6 +239,13 @@ class RaftNode:
 
     # -- elections -----------------------------------------------------------
     def _start_election(self) -> None:
+        if self.role != CANDIDATE:
+            # a new episode: first candidacy after losing sight of a leader.
+            # Re-elections after split votes extend the SAME episode — the
+            # observable outage is one window, however many terms it burns.
+            cause = "startup" if self.state.current_term == 0 else "timeout"
+            self._election_started = (_time.perf_counter(), _time.time(),
+                                      self._ticks, cause)
         self.state.current_term += 1
         self.role = CANDIDATE
         self.state.voted_for = self.node_id
@@ -226,12 +269,48 @@ class RaftNode:
             self._match_index = {p: 0 for p in self.peers}
             log.info("%s is leader for term %d", self.node_id,
                      self.state.current_term)
+            self._record_election_won()
             # a current-term no-op lets _maybe_commit advance over entries
             # replicated in previous terms (Raft 5.4.2 liveness)
             self.state.log.append(LogEntry(self.state.current_term, NOOP))
             self._persist_append()
             self._broadcast_append()
             self._maybe_commit()
+
+    def _record_election_won(self) -> None:
+        """Close the open election episode: this node won leadership."""
+        now_perf, now_epoch = _time.perf_counter(), _time.time()
+        started = self._election_started
+        self._election_started = None
+        self._leader_since = (now_perf, now_epoch)
+        self._elections_total += 1
+        if started is None:
+            return
+        perf_t0, epoch_t0, tick0, cause = started
+        episode = {"term": self.state.current_term, "cause": cause,
+                   "duration_s": now_perf - perf_t0,
+                   "ticks": self._ticks - tick0, "started_at": epoch_t0}
+        self._elections.append(episode)
+        from ..observability import get_tracer, jlog
+        jlog(log, "raft.election.won", node=self.node_id, **episode)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.record("raft.election", start_s=epoch_t0,
+                          duration_s=episode["duration_s"],
+                          node=self.node_id, term=episode["term"],
+                          cause=cause, ticks=episode["ticks"])
+
+    def _end_leader_tenure(self) -> None:
+        """Deposed (or stepped down): bank the tenure, drop stale per-entry
+        clocks — entries we appended as leader may never commit and would
+        otherwise pin their timing records forever."""
+        if self._leader_since is not None:
+            tenure = _time.perf_counter() - self._leader_since[0]
+            self._leader_tenure_last_s = tenure
+            self._leader_tenure_total_s += tenure
+            self._leader_since = None
+        self._entry_clock.clear()
+        self._submit_clock.clear()
 
     # -- replication ---------------------------------------------------------
     def _broadcast_append(self) -> None:
@@ -266,6 +345,10 @@ class RaftNode:
         tracer = get_tracer()
         jlog(log, "raft.submit", ctx=trace_ctx, node=self.node_id,
              role=self.role)
+        # the attribution clock starts BEFORE the lock: contending with the
+        # pump thread's tick is append-queue wait the caller experiences,
+        # so it must land in the append_wait component, not vanish
+        perf_t0, epoch_t0 = _time.perf_counter(), _time.time()
         with self._lock:
             fut: Future = Future()
             rid = next(self._request_ids)
@@ -275,8 +358,13 @@ class RaftNode:
                     "raft.submit", parent=trace_ctx, node=self.node_id,
                     role=self.role, request_id=rid)
             self._pending[rid] = fut
-            req = ClientRequest(rid, self.node_id, entry)
+            req = ClientRequest(rid, self.node_id, entry,
+                                submit_perf_ns=int(perf_t0 * 1e9))
             if self.role == LEADER:
+                # local leader submit: the per-entry component sum then
+                # telescopes to the same submit→resolve interval the
+                # caller measures
+                self._submit_clock[(self.node_id, rid)] = (perf_t0, epoch_t0)
                 self._handle_client_request(req)
             elif self.leader_id is not None:
                 self._post(self.leader_id, req)
@@ -299,9 +387,29 @@ class RaftNode:
             self._post(req.client, ClientResponse(
                 req.request_id, error="not leader", leader_hint=self.leader_id))
             return
+        perf_append = _time.perf_counter()
+        clock = self._submit_clock.pop((req.client, req.request_id), None)
+        if clock is None:
+            # forwarded from a follower: the client's submit stamp rides the
+            # request, so the forward hop lands in append_wait — exactly the
+            # queue wait the caller experiences (the conservation probe broke
+            # 45% when rounds forwarded to a post-election leader and these
+            # hops vanished). An absent or insane stamp (hostile peer, clock
+            # from the future) falls back to receipt.
+            sp_ns = getattr(req, "submit_perf_ns", None)
+            sp = sp_ns / 1e9 if type(sp_ns) is int else None
+            if sp is not None and 0.0 < sp <= perf_append:
+                clock = (sp, _time.time() - (perf_append - sp))
+            else:
+                clock = (perf_append, _time.time())
         self.state.log.append(LogEntry(self.state.current_term, req.entry,
                                        req.client, req.request_id))
         self._persist_append()
+        self._entry_clock[(self.state.current_term,
+                           self.state.last_index())] = \
+            [clock[0], clock[1], perf_append, _time.perf_counter()]
+        if len(self._entry_clock) > self.ATTRIB_SAMPLE_CAP:
+            self._entry_clock.clear()   # straggler-record runaway guard
         self._broadcast_append()
         self._maybe_commit()   # single-node cluster commits immediately
 
@@ -311,6 +419,8 @@ class RaftNode:
 
     def _observe_term(self, term: int) -> None:
         if term > self.state.current_term:
+            if self.role == LEADER:
+                self._end_leader_tenure()
             self.state.current_term = term
             self.state.voted_for = None
             self._persist_meta()
@@ -364,6 +474,7 @@ class RaftNode:
             return
         self.role = FOLLOWER
         self.leader_id = m.leader
+        self._election_started = None   # another node won this episode
         self._election_deadline = self._new_election_timeout()
         # consistency check at prev_log_index (negative values never come
         # from a correct leader and would index the log from the end)
@@ -439,13 +550,20 @@ class RaftNode:
             entry = self.state.log[self.state.last_applied - 1]
             if entry.entry == NOOP:
                 continue
+            clock = self._entry_clock.pop(
+                (entry.term, self.state.last_applied), None)
+            perf_commit = _time.perf_counter() if clock is not None else 0.0
             try:
                 result = self.apply_fn(entry.entry)
                 error = None
             except Exception as e:
                 result, error = None, str(e)
+            perf_end = _time.perf_counter()
+            if clock is not None:
+                self._record_attribution(entry, clock, perf_commit, perf_end)
             if entry.client is not None and entry.request_id is not None:
-                resp = ClientResponse(entry.request_id, result, error)
+                resp = ClientResponse(entry.request_id, result, error,
+                                      resolved_perf_ns=int(perf_end * 1e9))
                 if entry.client == self.node_id:
                     self._resolve(resp)
                 elif self.role == LEADER:
@@ -453,6 +571,96 @@ class RaftNode:
 
     def _on_client_response(self, m: ClientResponse) -> None:
         self._resolve(m)
+
+    # -- introspection (consensus observatory) --------------------------------
+    def _record_attribution(self, entry: LogEntry, clock: list,
+                            perf_commit: float, perf_end: float) -> None:
+        """One committed entry's commit-path decomposition: append-queue
+        wait, local fsync (_persist_append), replication (append → quorum
+        commit), apply. The four parts are CONTIGUOUS, so their sum is
+        exactly the submit→applied interval — the invariant the bench
+        validity probe holds against the measured round time."""
+        perf_t0, epoch_t0, perf_append, perf_fsync_end = clock
+        fsync = perf_fsync_end - perf_append
+        replicate = max(0.0, perf_commit - perf_fsync_end)
+        apply_s = perf_end - perf_commit
+        self._attrib["append_wait"].append(perf_append - perf_t0)
+        self._attrib["fsync"].append(fsync)
+        self._attrib["replicate"].append(replicate)
+        self._attrib["apply"].append(apply_s)
+        self._attrib["total"].append(perf_end - perf_t0)
+        # retroactive child spans under the pending raft.submit span: the
+        # critical-path extractor can now decompose raft.commit one level
+        # deeper (raft.fsync / raft.replicate components)
+        if entry.client != self.node_id or entry.request_id is None:
+            return
+        fut = self._pending.get(entry.request_id)
+        span = getattr(fut, "raft_trace_span", None) if fut is not None \
+            else None
+        if span is None:
+            return
+        from ..observability import get_tracer
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        ctx = span.context()
+        t = epoch_t0 + (perf_append - perf_t0)
+        tracer.record("raft.fsync", parent=ctx, start_s=t,
+                      duration_s=fsync, node=self.node_id)
+        tracer.record("raft.replicate", parent=ctx, start_s=t + fsync,
+                      duration_s=replicate, node=self.node_id)
+        tracer.record("raft.apply", parent=ctx,
+                      start_s=t + fsync + replicate,
+                      duration_s=apply_s, node=self.node_id)
+
+    def attribution_samples(self) -> dict:
+        """Exact retained per-commit component samples (seconds), keyed
+        append_wait / fsync / replicate / apply / total. Only the leader
+        that appended an entry holds its samples — pool across replicas."""
+        with self._lock:
+            return {k: list(v) for k, v in self._attrib.items()}
+
+    def stats(self) -> dict:
+        """Introspection snapshot (the /debug/raft payload's per-node leaf).
+        Everything is cheap reads under the node lock; attribution
+        percentiles come from the exact retained samples."""
+        with self._lock:
+            now = _time.perf_counter()
+            out = {
+                "impl": "python",
+                "node": self.node_id,
+                "role": self.role,
+                "term": self.state.current_term,
+                "leader_id": self.leader_id,
+                "commit_index": self.state.commit_index,
+                "last_applied": self.state.last_applied,
+                "log_entries": self.state.last_index(),
+                "elections_total": self._elections_total,
+                "elections": list(self._elections),
+                "leader_tenure_s": (now - self._leader_since[0]
+                                    if self._leader_since is not None
+                                    else 0.0),
+                "leader_tenure_last_s": self._leader_tenure_last_s,
+                "pending_requests": len(self._pending),
+            }
+            if self.role == LEADER:
+                last = self.state.last_index()
+                out["peer_lag"] = {
+                    p: max(0, last - self._match_index.get(p, 0))
+                    for p in self.peers}
+            attrib = {}
+            for name, samples in self._attrib.items():
+                if not samples:
+                    continue
+                s = sorted(samples)
+                attrib[name] = {
+                    "n": len(s),
+                    "p50_ms": _pctl(s, 0.50) * 1000.0,
+                    "p99_ms": _pctl(s, 0.99) * 1000.0,
+                    "mean_ms": (sum(s) / len(s)) * 1000.0,
+                }
+            out["attribution"] = attrib
+            return out
 
     def _resolve(self, m: ClientResponse) -> None:
         fut = self._pending.pop(m.request_id, None)
@@ -463,10 +671,25 @@ class RaftNode:
             if m.error is not None:
                 span.set_tag("error", m.error)
             span.finish()
+        # resolution stamp: lets the caller measure submit→resolve without
+        # the waiter's thread-wakeup noise (GroupCommitter round samples —
+        # the attribution-sum probe's measured side). Prefer the leader's
+        # apply-end stamp carried on the response: the delivery hop back
+        # then cancels out of the round, matching the interval the leader's
+        # attribution telescopes over.
+        rp_ns = getattr(m, "resolved_perf_ns", None)
+        fut.raft_resolved_perf = rp_ns / 1e9 \
+            if type(rp_ns) is int and rp_ns > 0 else _time.perf_counter()
         if m.error is not None:
             fut.set_exception(RaftApplyError(m.error))
         else:
             fut.set_result(m.result)
+
+
+def _pctl(sorted_samples, q: float) -> float:
+    """Nearest-rank percentile of an already-sorted sample list."""
+    idx = min(len(sorted_samples) - 1, int(q * len(sorted_samples)))
+    return sorted_samples[idx]
 
 
 class RaftApplyError(Exception):

@@ -153,6 +153,9 @@ class NativeRaftNode:
         self._request_ids = iter(range(1, 1 << 62))
         self._pending: dict[int, Future] = {}
         self._lock = threading.RLock()
+        self._elections_total = 0
+        self._leader_since: float | None = None
+        self._leader_tenure_last_s = 0.0
         self._registration = messaging.add_message_handler(
             TopicSession(TOPIC_RAFT), self._on_message)
 
@@ -271,6 +274,9 @@ class NativeRaftNode:
             elif kind == _ACT_APPLY:
                 self._apply(data)
             elif kind == _ACT_BECAME_LEADER:
+                import time as _t
+                self._elections_total += 1
+                self._leader_since = _t.perf_counter()
                 log.info("%s (native core) is leader for term %d",
                          self.node_id, view.a)
 
@@ -296,6 +302,37 @@ class NativeRaftNode:
             fut.set_exception(RaftApplyError(m.error))
         else:
             fut.set_result(m.result)
+
+    def stats(self) -> dict:
+        """Observatory parity with RaftNode.stats(): everything the C core's
+        getters expose. Fields the core cannot attribute (per-entry commit
+        decomposition, election episode timings, per-peer lag) are ABSENT —
+        never zero — so a mixed python/native fleet renders one coherent
+        observatory with honest gaps."""
+        import time as _t
+        with self._lock:
+            role = self.role
+            if role != LEADER and self._leader_since is not None:
+                # deposed since the last drain: bank the tenure lazily (the
+                # core surfaces no step-down action)
+                self._leader_tenure_last_s = \
+                    _t.perf_counter() - self._leader_since
+                self._leader_since = None
+            return {
+                "impl": "native",
+                "node": self.node_id,
+                "role": role,
+                "term": _LIB.raft_term(self._handle),
+                "leader_id": self.leader_id,
+                "commit_index": _LIB.raft_commit_index(self._handle),
+                "log_entries": _LIB.raft_last_index(self._handle),
+                "elections_total": self._elections_total,
+                "leader_tenure_s": (_t.perf_counter() - self._leader_since
+                                    if self._leader_since is not None
+                                    else 0.0),
+                "leader_tenure_last_s": self._leader_tenure_last_s,
+                "pending_requests": len(self._pending),
+            }
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

@@ -329,6 +329,42 @@ class CordaRPCOps:
         from ..observability import critpath, get_tracer
         return critpath.critpath_report(get_tracer().traces(), top_k=top_k)
 
+    def raft_report(self) -> dict:
+        """Consensus observatory for /debug/raft: per-group raft
+        introspection (leader, term, log length, election episodes,
+        commit-path attribution percentiles) plus shard heat/skew when
+        this node's notary shards its uniqueness provider. Empty-groups
+        dict for a non-notary node — the endpoint is always safe."""
+        from ..observability import consensus_obs
+        groups: dict = {}
+        sharded = None
+        notary = getattr(self.hub, "notary_service", None)
+        uniq = getattr(notary, "uniqueness", None) \
+            if notary is not None else None
+        if uniq is not None:
+            shards = getattr(uniq, "shards", None)
+            if shards:
+                sharded = uniq
+                for s, provider in enumerate(shards):
+                    raft = getattr(provider, "raft", None)
+                    if raft is not None:
+                        groups[f"s{s}"] = [raft]
+            else:
+                raft = getattr(uniq, "raft", None)
+                if raft is not None:
+                    groups["s0"] = [raft]
+        return consensus_obs.raft_report(groups, sharded=sharded)
+
+    def timeseries_snapshot(self, names=None, limit: int | None = None
+                            ) -> dict:
+        """Retained time-series plane for /api/timeseries: downsampled
+        multi-resolution history of the consensus gauges sampled by the
+        raft pump (observability/timeseries.py). ``names`` filters to
+        specific series, ``limit`` caps rows per resolution. Well-formed
+        and empty when nothing has been recorded."""
+        from ..observability import get_timeseries
+        return get_timeseries().snapshot(names=names, limit=limit)
+
     def vault_feed(self, state_type: type | None = None) -> DataFeed:
         def subscribe(cb):
             self.hub.vault.add_update_observer(cb)

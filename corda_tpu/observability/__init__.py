@@ -24,10 +24,19 @@ Three pieces (docs/OBSERVABILITY.md):
 - critpath.py — tail forensics: critical-path (blocking chain) extraction
   over stitched span trees, wait_kind blame attribution, the
   ``ledger_critpath_*`` artifact fields and /debug/critpath payload.
+- timeseries.py — the retained time-series plane: memory-bounded,
+  downsampled history (fine recent rings cascading into coarse older
+  rings) behind /api/timeseries and the consensus_stat CLI.
+- consensus_obs.py — the consensus observatory: raft stats pooling
+  (/debug/raft), Raft.* metric families, growth watchdogs, and the
+  ``ledger_raft_*`` artifact fields.
 
 The Histogram metric type itself lives in utils/metrics.py with the rest
 of the registry.
 """
+from .consensus_obs import (ATTRIBUTION_COMPONENTS, GrowthWatch,
+                            install_raft_collector, ledger_raft_fields,
+                            raft_report, sample_timeseries)
 from .critpath import (COMPONENTS, WAIT_KINDS, aggregate_critpaths,
                        component_of, critical_path, critpath_report,
                        flow_kind, ledger_critpath_fields)
@@ -40,18 +49,25 @@ from .slog import jlog
 from .slo import DEFAULT_OBJECTIVES, SLObjective, SLOTracker
 from .stages import (LEDGER_STAGE_METRICS, STAGE_METRICS,
                      ledger_stage_percentiles, stage_percentiles)
+from .timeseries import (TimeSeries, TimeSeriesStore, get_timeseries,
+                         set_timeseries)
 from .tracing import (NOOP_SPAN, NOOP_TRACER, NoopTracer, Span, SpanContext,
                       Tracer, disable_tracing, enable_tracing, get_tracer,
                       make_span_dict, set_tracer)
 
 __all__ = [
-    "COMPONENTS", "DEFAULT_OBJECTIVES", "FleetMetricsFederation",
+    "ATTRIBUTION_COMPONENTS", "COMPONENTS", "DEFAULT_OBJECTIVES",
+    "FleetMetricsFederation", "GrowthWatch",
     "KernelProfiler", "LEDGER_STAGE_METRICS", "NOOP_SPAN", "NOOP_TRACER",
     "NoopTracer", "OverlapTracker", "RequestLog", "SLObjective",
     "SLOTracker", "Span", "SpanContext", "SpanRing", "STAGE_METRICS",
+    "TimeSeries", "TimeSeriesStore",
     "Tracer", "WAIT_KINDS", "aggregate_critpaths", "component_of",
     "critical_path", "critpath_report", "disable_tracing",
-    "enable_tracing", "flow_kind", "get_profiler", "get_tracer", "jlog",
-    "ledger_critpath_fields", "ledger_stage_percentiles", "make_span_dict",
-    "set_profiler", "set_tracer", "stage_percentiles",
+    "enable_tracing", "flow_kind", "get_profiler", "get_timeseries",
+    "get_tracer", "install_raft_collector", "jlog",
+    "ledger_critpath_fields", "ledger_raft_fields",
+    "ledger_stage_percentiles", "make_span_dict", "raft_report",
+    "sample_timeseries", "set_profiler", "set_timeseries", "set_tracer",
+    "stage_percentiles",
 ]

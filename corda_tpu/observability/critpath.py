@@ -42,7 +42,8 @@ __all__ = [
 #: Blame components, display order. Every critical-path millisecond lands
 #: in exactly one of these.
 COMPONENTS = ("flow.compute", "scheduler.wait", "verify",
-              "notary.batch_wait", "raft.commit", "raft.leaderless",
+              "notary.batch_wait", "raft.commit", "raft.fsync",
+              "raft.replicate", "raft.leaderless",
               "cross_shard", "vault", "network", "other")
 
 #: wait_kind taxonomy: tag value -> blame component. One row per
@@ -78,6 +79,11 @@ _NAME_RULES = (
     ("batcher.", "verify"),
     ("worker.", "verify"),
     ("notary.", "notary.batch_wait"),
+    # one level below raft.commit: the attribution child spans RaftNode
+    # records per committed entry (consensus observatory). raft.apply and
+    # raft.election deliberately fall through to the raft.commit rule.
+    ("raft.fsync", "raft.fsync"),
+    ("raft.replicate", "raft.replicate"),
     ("raft.", "raft.commit"),
     ("vault.", "vault"),
     ("session.", "network"),

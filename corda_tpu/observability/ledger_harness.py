@@ -436,13 +436,42 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
     raft_names = set(names)
     stop = threading.Event()
 
+    # consensus observatory (ISSUE 16): Raft.* families on the shared
+    # registry, a run-scoped retained time-series plane sampled from the
+    # pump, growth watchdogs, and pump-tick utilization.
+    from .consensus_obs import (GrowthWatch, install_raft_collector,
+                                ledger_raft_fields, sample_timeseries)
+    from .timeseries import TimeSeriesStore, set_timeseries
+    raft_groups = {f"s{s}": [p.raft for p in grp]
+                   for s, grp in enumerate(shard_providers)}
+    ts_store = TimeSeriesStore()
+    prior_ts = set_timeseries(ts_store)
+    growth = GrowthWatch()
+    sharded_ref: dict = {"provider": None}   # filled once topology settles
+    install_raft_collector(registry, lambda: raft_groups)
+    pump_stats = {"busy_s": 0.0, "loops": 0}
+    pump_started = time.monotonic()
+
     def raft_pump():
+        last_sample = 0.0
         while not stop.is_set():
+            t0 = time.monotonic()
             for rn in raft_nodes:
                 rn.tick()
             for name in names:
                 while network.bus.pump_receive(name) is not None:
                     pass
+            t1 = time.monotonic()
+            pump_stats["busy_s"] += t1 - t0
+            pump_stats["loops"] += 1
+            if t1 - last_sample >= 0.25:
+                last_sample = t1
+                try:
+                    sample_timeseries(ts_store, raft_groups,
+                                      sharded=sharded_ref["provider"],
+                                      watch=growth)
+                except Exception:
+                    pass   # observability must never stall consensus
             time.sleep(0.002)
 
     pump_thread = threading.Thread(target=raft_pump, daemon=True,
@@ -473,6 +502,7 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
                 metrics=registry)
             notary.install_notary(ShardedNotaryService,
                                   uniqueness=uniq_provider)
+            sharded_ref["provider"] = uniq_provider
 
         ops = _build_ops(cfg)
         chaos = _ChaosSchedule(cfg, raft_nodes,
@@ -798,6 +828,41 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
         # components sum to that transaction's e2e — the conservation
         # property bench.py probes and benchguard locks.
         report.update(ledger_critpath_fields(traces))
+        # consensus observatory (ISSUE 16): pooled per-entry raft
+        # attribution (exact samples off every replica — they live on
+        # whichever node led when the entry committed), the measured
+        # consensus-round distribution they must telescope to (bench.py's
+        # attribution-sum validity probe), pump utilization, shard skew,
+        # and the retained time-series plane's resolution count.
+        ts_store.flush()           # seal every ring so all resolutions show
+        round_samples: list = []
+        for p_ in shard_entry:
+            gc = getattr(p_, "group_committer", None)
+            if gc is not None and hasattr(gc, "round_samples"):
+                round_samples.extend(gc.round_samples())
+        if n_shards > 1:
+            round_samples.extend(uniq_provider.round_samples())
+        report.update(ledger_raft_fields(raft_groups, round_samples))
+        pump_wall = max(1e-9, time.monotonic() - pump_started)
+        report["ledger_raft_pump_busy_frac"] = round(
+            min(1.0, pump_stats["busy_s"] / pump_wall), 4)
+        if n_shards > 1:
+            heat = uniq_provider.heat_stats()
+            report["ledger_shard_skew_index"] = round(
+                heat["skew_index"], 4)
+            report["ledger_coordinator_log_bytes"] = int(
+                heat["coordinator_log_bytes"])
+        else:
+            # one shard is trivially even (max == mean) once it saw load
+            report["ledger_shard_skew_index"] = 1.0 if notarised_txs \
+                else 0.0
+            report["ledger_coordinator_log_bytes"] = 0
+        ts_snap = ts_store.snapshot()
+        report["ledger_timeseries_resolutions"] = max(
+            (sum(1 for ring in series if ring["points"])
+             for name, series in ts_snap["series"].items()
+             if name.startswith("Raft.LogEntries")), default=0)
+        report["ledger_growth_warnings"] = growth.warnings
         # the ISSUE's named headline for the double-spend check, duplicated
         # from the stage percentile so benchguard can floor it directly
         report["notary_uniqueness_p99_ms"] = report.get(
@@ -832,6 +897,7 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
         except Exception:
             pass
         set_tracer(prior_tracer)
+        set_timeseries(prior_ts)
 
 
 # ---------------------------------------------------------------------------
@@ -1150,9 +1216,16 @@ def run_shard_sweep_point(cfg: ShardSweepConfig | None = None) -> dict:
 
         lat = sorted(latencies)
         snapshot = registry.snapshot()
+        try:
+            heat = sharded.heat_stats()
+        except Exception:
+            heat = {"skew_index": 0.0, "coordinator_log_bytes": 0}
         return {
             "shards": n_shards,
             "operations": total,
+            "skew_index": round(float(heat.get("skew_index", 0.0)), 4),
+            "coordinator_log_bytes": int(
+                heat.get("coordinator_log_bytes", 0)),
             "offered_tx_per_sec": cfg.rate_tx_per_sec,
             "committed": outcomes["committed"],
             "rejected": outcomes["rejected"],
@@ -1222,6 +1295,10 @@ def shard_scaling_fields(points: list[dict]) -> dict:
     cross_a = sum(p.get("cross_shard_aborted", 0) for p in points)
     out["shard_sweep_abort_rate"] = round(
         cross_a / (cross_a + cross_c), 4) if (cross_a + cross_c) else 0.0
+    # worst skew any point saw (per-shard request imbalance; 1.0 == even,
+    # 0.0 == a pre-r05 point that never measured it)
+    out["shard_sweep_skew_index"] = round(max(
+        (float(p.get("skew_index", 0.0)) for p in points), default=0.0), 4)
     out["shard_sweep_ok"] = bool(points) and all(
         p["exactly_once_ok"] and p["replicas_agree"]
         and p["reserved_leftover"] == 0 for p in points)

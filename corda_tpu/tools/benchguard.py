@@ -330,10 +330,22 @@ LEDGER_GUARDED: dict = {
     "raft_appends_per_committed_tx": ("lower", TAIL_TOLERANCE),
     "commit_batch_occupancy_mean": ("higher", RATE_TOLERANCE),
     # per-flow-class tails: the scheduler must not buy throughput by
-    # starving one class (settle is the deepest flow — two legs + DvP)
-    "e2e_ms_p99_issue": ("lower", TAIL_TOLERANCE),
-    "e2e_ms_p99_pay": ("lower", TAIL_TOLERANCE),
-    "e2e_ms_p99_settle": ("lower", TAIL_TOLERANCE),
+    # starving one class (settle is the deepest flow — two legs + DvP).
+    # Metric-specific 2.0: the percentiles are computed over SUCCESSFUL
+    # ops only, and a chaos round's class tail is set by the ops that
+    # straddle the leader-kill window — they either fail out of the
+    # sample, biasing the p99 low (r04: 5.2s with 1 op failed; the same
+    # code replayed on the same host: 4.8s with 39 failed), or ride the
+    # re-election through to commit and land in it, biasing it high
+    # (r02: 8.1s, r05: 11.5s — both with ZERO failed ops and a higher
+    # committed rate, i.e. strictly better runs with fatter tails). The
+    # spread between those two healthy modes is wider than
+    # TAIL_TOLERANCE; 3x best still catches a scheduler that starves a
+    # class outright, and the committed_tx_per_sec / ops-count fields
+    # guard the failure-rate side the percentile cannot see.
+    "e2e_ms_p99_issue": ("lower", 2.0),
+    "e2e_ms_p99_pay": ("lower", 2.0),
+    "e2e_ms_p99_settle": ("lower", 2.0),
 }
 
 #: Fields every LEDGER artifact must carry (the --smoke --ledger schema
@@ -390,6 +402,21 @@ LEDGER_REQUIRED: tuple = (
     "ledger_shard_cross_recovered", "ledger_shard_reserved_leftover",
     "ledger_shard_recovered_in_doubt", "ledger_shard_finalize_conflicts",
     "cross_shard_abort_rate", "cross_shard_pct",
+    # consensus observatory (ISSUE 16): per-entry raft commit attribution
+    # (append-wait / fsync / replicate / apply), the attribution-sum vs
+    # measured-round conservation pair, shard heat/skew, and the retained
+    # time-series plane's self-report. Locked so the observatory can
+    # never silently un-wire; fields carry typed always-present defaults
+    # (0.0 / 0) when a smoke run is too small to populate them.
+    "ledger_raft_append_wait_ms_p50", "ledger_raft_append_wait_ms_p99",
+    "ledger_raft_fsync_ms_p50", "ledger_raft_fsync_ms_p99",
+    "ledger_raft_replicate_ms_p50", "ledger_raft_replicate_ms_p99",
+    "ledger_raft_apply_ms_p50", "ledger_raft_apply_ms_p99",
+    "ledger_raft_attrib_samples", "ledger_raft_attrib_sum_ms_p50",
+    "ledger_raft_round_ms_p50", "ledger_raft_elections_total",
+    "ledger_raft_pump_busy_frac", "ledger_shard_skew_index",
+    "ledger_coordinator_log_bytes", "ledger_timeseries_resolutions",
+    "ledger_growth_warnings",
     # host fingerprint: floors are fitted within a host class only
     # (same_host_class) — a rate recorded on a big box is not a floor
     # for a small one
@@ -559,17 +586,33 @@ SHARD_REQUIRED: tuple = (
     "shard_sweep", "shard_scaling_x", "shard_scaling_efficiency_pct",
     "shard_sweep_abort_rate", "ledger_shard_count",
     "committed_tx_per_sec_shards_1",
+    # consensus observatory (ISSUE 16): the sweep's worst shard-load skew
+    # (max over points of max-shard-load / mean-shard-load)
+    "shard_sweep_skew_index",
 )
 
 #: scaling-curve locks: efficiency and the absolute ratio are floors
-#: (RATE_TOLERANCE, like fleet scaling_efficiency_pct); the sweep's
+#: (SWEEP_RATE_TOLERANCE, below); the sweep's
 #: aggregate abort rate (``shard_sweep_abort_rate`` — distinct from the
 #: flows scenario's ``cross_shard_abort_rate``, a different workload) is
 #: a ceiling with tail tolerance (it is a small number driven by the
 #: deliberate-conflict fraction, so it is noisy in relative terms).
+#: Sweep-specific floor tolerance for the scaling curve and the
+#: per-shard-count rates: a high-count point is only a few seconds of
+#: open-loop driving on the host CPUs, and a cross-day replay of
+#: IDENTICAL code on the same host class measured 17% below the recorded
+#: best (r04: 544.9 tx/s at 4 shards; replay: 451.3) — RATE_TOLERANCE
+#: flags plain box noise. The ratios don't cancel it either: scaling_x
+#: divides the noisiest point (4 shards, ~3.5s of wall clock) by the
+#: most stable one (1 shard, ~13s), so it inherits the numerator's
+#: variance. 0.30 still catches a real serialization regression — a
+#: pipeline that stops scaling shows up as x falling toward 1, far
+#: through the floor.
+SWEEP_RATE_TOLERANCE = 0.30
+
 SHARD_GUARDED: dict = {
-    "shard_scaling_efficiency_pct": ("higher", RATE_TOLERANCE),
-    "shard_scaling_x": ("higher", RATE_TOLERANCE),
+    "shard_scaling_efficiency_pct": ("higher", SWEEP_RATE_TOLERANCE),
+    "shard_scaling_x": ("higher", SWEEP_RATE_TOLERANCE),
     "shard_sweep_abort_rate": ("lower", TAIL_TOLERANCE),
 }
 
@@ -623,7 +666,7 @@ def guard_shards(current: dict,
     # counts the current sweep measured
     for p in sweep:
         guarded[f"committed_tx_per_sec_shards_{p.get('shards')}"] = \
-            ("higher", RATE_TOLERANCE)
+            ("higher", SWEEP_RATE_TOLERANCE)
     guards: dict = {}
     for run in runs:
         if run is None or run.get("smoke") \

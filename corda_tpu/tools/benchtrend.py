@@ -47,7 +47,11 @@ FAMILIES = {
                 # shard scaling (rounds before r04 render "-")
                 "ledger_shard_count",
                 "shard_scaling_efficiency_pct",
-                "shard_sweep_abort_rate")),
+                "shard_sweep_abort_rate",
+                # consensus observatory (rounds before r05 render "-")
+                "ledger_raft_fsync_ms_p99",
+                "ledger_raft_replicate_ms_p99",
+                "ledger_shard_skew_index")),
 }
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")

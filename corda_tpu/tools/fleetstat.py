@@ -1,9 +1,11 @@
 """fleetstat — a `top`-style live view of a node's verifier fleet.
 
-Polls the node webserver's JSON surfaces (/api/fleet + /api/metrics) and
-renders one worker per row: attach state, report freshness, queue depth,
-capacity, and the federated per-worker throughput families. Pure-stdlib
-(urllib + ANSI clear), so it runs anywhere the node does::
+Polls the node webserver's JSON surfaces (/api/fleet + /api/metrics, plus
+/debug/critpath and /debug/raft when the node answers them) and renders
+one worker per row: attach state, report freshness, queue depth,
+capacity, and the federated per-worker throughput families — plus one
+consensus line per raft group. Pure-stdlib (urllib + ANSI clear), so it
+runs anywhere the node does::
 
     python -m corda_tpu.tools.fleetstat http://127.0.0.1:8080
     python -m corda_tpu.tools.fleetstat http://127.0.0.1:8080 --once
@@ -61,7 +63,8 @@ def _cell(value, default):
     return value
 
 
-def render(fleet: dict, metrics: dict, critpath: dict | None = None) -> str:
+def render(fleet: dict, metrics: dict, critpath: dict | None = None,
+           raft: dict | None = None) -> str:
     """One screenful: fleet header + a row per worker, plus (when the
     node answers /debug/critpath) one tail-forensics line per flow class:
     the dominant blame component and its p50 share. Pure function of the
@@ -149,6 +152,45 @@ def render(fleet: dict, metrics: dict, critpath: dict | None = None) -> str:
         lines.append("shard commits: " + "  ".join(shard_cells))
     elif isinstance(metrics.get("GroupCommit.Committed"), dict):
         lines.append("shard commits: -")
+    # consensus observatory (ISSUE 16): one line per raft group from
+    # /debug/raft — role of the reporting leader, tenure, election count,
+    # fsync p99, max peer lag, log length. A native core that cannot
+    # attribute renders "-" cells; a malformed payload renders nothing.
+    groups = raft.get("groups") if isinstance(raft, dict) else None
+    if isinstance(groups, dict) and groups:
+        parts = []
+        for label in sorted(groups, key=str):
+            g = groups[label]
+            if not isinstance(g, dict):
+                continue
+            leader = g.get("leader")
+            leader = leader if isinstance(leader, dict) else {}
+            tenure = leader.get("leader_tenure_s")
+            tenure_txt = (f"{tenure:.0f}s"
+                          if isinstance(tenure, (int, float))
+                          and not isinstance(tenure, bool) else "-")
+            lag = leader.get("peer_lag")
+            lag_max = max((v for v in lag.values()
+                           if isinstance(v, (int, float))
+                           and not isinstance(v, bool)), default=0) \
+                if isinstance(lag, dict) else "-"
+            attrib = g.get("attribution")
+            fsync = attrib.get("fsync") if isinstance(attrib, dict) else None
+            p99 = fsync.get("p99_ms") if isinstance(fsync, dict) else None
+            fsync_txt = (f"{p99:.1f}ms"
+                         if isinstance(p99, (int, float))
+                         and not isinstance(p99, bool) else "-")
+            parts.append(
+                f"{label}:"
+                f"{'leader' if leader else 'no-leader'}"
+                f"({_cell(leader.get('node'), '?')})"
+                f" tenure={tenure_txt}"
+                f" elections={_cell(g.get('elections_total'), 0)}"
+                f" fsync_p99={fsync_txt}"
+                f" lag={_cell(lag_max, '-')}"
+                f" log={_cell(g.get('log_entries'), 0)}")
+        if parts:
+            lines.append("consensus: " + "  ".join(parts))
     per_class = critpath.get("per_class") if isinstance(critpath, dict) \
         else None
     if isinstance(per_class, dict) and per_class:
@@ -196,7 +238,13 @@ def main(argv=None) -> int:
             critpath = fetch(args.url, "/debug/critpath?top_k=1")
         except Exception:
             critpath = None
-        screen = render(fleet, metrics, critpath)
+        try:
+            # optional surface: a node predating the consensus
+            # observatory just loses the consensus line
+            raft = fetch(args.url, "/debug/raft")
+        except Exception:
+            raft = None
+        screen = render(fleet, metrics, critpath, raft)
         if args.once:
             print(screen)
             return 0

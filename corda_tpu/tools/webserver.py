@@ -17,6 +17,8 @@ app APIs and static content. Endpoints:
     GET  /debug/profile         kernel flight-recorder snapshot
     GET  /debug/requests        per-request lifecycle timelines (fleet)
     GET  /debug/critpath        critical-path blame + top-K slow traces
+    GET  /debug/raft            consensus observatory: raft groups + shards
+    GET  /api/timeseries        retained downsampled consensus time series
     GET  /api/fleet             fleet membership + per-worker load
     GET  /traces                span ring (tracing enabled: spans by trace)
     POST /api/flows/<FlowName>  body: JSON list of args -> run id / result
@@ -321,6 +323,25 @@ class NodeWebServer:
                     except Exception as e:
                         self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
+                if (self.path == "/debug/raft"
+                        or self.path.startswith("/debug/raft?")):
+                    try:
+                        self._reply(200, server.handle_debug_raft(self.path))
+                    except ValueError as e:
+                        self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                    except Exception as e:
+                        self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                if (self.path == "/api/timeseries"
+                        or self.path.startswith("/api/timeseries?")):
+                    try:
+                        self._reply(200, server.handle_api_timeseries(
+                            self.path))
+                    except ValueError as e:
+                        self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+                    except Exception as e:
+                        self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
                 if self.path == "/traces" or self.path.startswith("/traces?"):
                     try:
                         ctype, body = server.handle_traces(self.path)
@@ -433,6 +454,42 @@ class NodeWebServer:
             return report_fn(top_k)
         from ..observability import critpath, get_tracer
         return critpath.critpath_report(get_tracer().traces(), top_k=top_k)
+
+    def handle_debug_raft(self, path: str) -> dict:
+        """GET /debug/raft — the consensus observatory: per-raft-group
+        introspection (leader, term, log length, election episodes,
+        commit-path attribution percentiles) plus shard heat/skew when
+        the node notarises over a sharded uniqueness provider. Served
+        from the ops object when it exposes ``raft_report`` (the node
+        RPC surface); an ops surface without one answers with empty
+        groups — scraping any node is safe."""
+        report_fn = getattr(self.ops, "raft_report", None)
+        if report_fn is None:
+            return {"groups": {}}
+        return report_fn()
+
+    def handle_api_timeseries(self, path: str) -> dict:
+        """GET /api/timeseries — the retained time-series plane:
+        downsampled multi-resolution history of the consensus gauges
+        (observability/timeseries.py). ``names`` (comma-separated)
+        filters to specific series; ``limit`` caps rows returned per
+        resolution ring. Served from the ops object when it exposes
+        ``timeseries_snapshot``, straight off the process store
+        otherwise; well-formed and empty when nothing was recorded."""
+        from urllib.parse import parse_qs, urlsplit
+        q = parse_qs(urlsplit(path).query)
+        names_raw = q.get("names", [None])[0]
+        names = [n for n in names_raw.split(",") if n] \
+            if names_raw is not None else None
+        limit_raw = q.get("limit", [None])[0]
+        limit = int(limit_raw) if limit_raw is not None else None
+        if limit is not None and limit < 1:
+            raise ValueError(f"limit must be >= 1, got {limit}")
+        snap_fn = getattr(self.ops, "timeseries_snapshot", None)
+        if snap_fn is not None:
+            return snap_fn(names, limit)
+        from ..observability import get_timeseries
+        return get_timeseries().snapshot(names=names, limit=limit)
 
     def handle_traces(self, path: str) -> tuple[str, bytes]:
         """GET /traces — spans from the live tracer's ring buffer.

@@ -263,6 +263,24 @@ def _ledger(**over):
         "ledger_shard_finalize_conflicts": 0,
         "cross_shard_abort_rate": 0.032,
         "cross_shard_pct": 0.15,
+        # consensus-observatory fields (ISSUE 16): raft commit attribution
+        # telescopes — append_wait+fsync+replicate+apply p50s sum to the
+        # attribution-sum p50, which matches the measured round p50
+        "ledger_raft_append_wait_ms_p50": 0.4,
+        "ledger_raft_append_wait_ms_p99": 2.0,
+        "ledger_raft_fsync_ms_p50": 1.1, "ledger_raft_fsync_ms_p99": 4.0,
+        "ledger_raft_replicate_ms_p50": 6.0,
+        "ledger_raft_replicate_ms_p99": 30.0,
+        "ledger_raft_apply_ms_p50": 0.5, "ledger_raft_apply_ms_p99": 2.0,
+        "ledger_raft_attrib_samples": 140,
+        "ledger_raft_attrib_sum_ms_p50": 8.0,
+        "ledger_raft_round_ms_p50": 8.3,
+        "ledger_raft_elections_total": 2,
+        "ledger_raft_pump_busy_frac": 0.12,
+        "ledger_shard_skew_index": 1.05,
+        "ledger_coordinator_log_bytes": 4096,
+        "ledger_timeseries_resolutions": 3,
+        "ledger_growth_warnings": 0,
         "host_cpus": 8,
     }
     base.update(over)
@@ -312,9 +330,13 @@ def test_ledger_group_commit_guards(tmp_path):
     problems = benchguard.guard_ledger(
         _ledger(commit_batch_occupancy_mean=4.76 * (1 - 0.16)), [str(good)])
     assert any("commit_batch_occupancy_mean" in p for p in problems)
+    # class tails carry a metric-specific 2.0 tolerance (chaos-straddle
+    # survivorship — see LEDGER_GUARDED): breach needs more than 3x best
     problems = benchguard.guard_ledger(
-        _ledger(e2e_ms_p99_settle=1500.0 * 1.6), [str(good)])
+        _ledger(e2e_ms_p99_settle=1500.0 * 3.1), [str(good)])
     assert any("e2e_ms_p99_settle" in p for p in problems)
+    assert benchguard.guard_ledger(
+        _ledger(e2e_ms_p99_settle=1500.0 * 2.9), [str(good)]) == []
     # within tolerance passes clean
     assert benchguard.guard_ledger(
         _ledger(raft_appends_per_committed_tx=0.25,
@@ -413,6 +435,7 @@ def _sharded(**over):
         shard_scaling_x=2300.0 / 700.0,
         shard_scaling_efficiency_pct=100.0 * (2300.0 / 700.0) / 4,
         shard_sweep_abort_rate=0.032,
+        shard_sweep_skew_index=1.05,
         shard_sweep_ok=True)
     base.update(over)
     return base
@@ -446,15 +469,21 @@ def test_shard_guard_schema_and_hard_invariants():
 def test_shard_guard_locks_scaling_floors(tmp_path):
     good = tmp_path / "LEDGER_r04.json"
     good.write_text(json.dumps(_sharded()))
-    # scaling efficiency collapse breaches its floor
+    # scaling efficiency collapse breaches its floor (the whole curve
+    # uses SWEEP_RATE_TOLERANCE=0.30 — see benchguard)
     worse = _sharded(shard_scaling_efficiency_pct=
-                     100.0 * (2300.0 / 700.0) / 4 * (1 - 0.16))
+                     100.0 * (2300.0 / 700.0) / 4 * (1 - 0.31))
     assert any("shard_scaling_efficiency_pct" in p
                for p in benchguard.guard_shards(worse, [str(good)]))
-    # a per-shard-count committed-rate collapse names its count
-    slow4 = _sharded(committed_tx_per_sec_shards_4=2300.0 * (1 - 0.16))
+    # a per-shard-count committed-rate collapse names its count (the
+    # sweep rates use SWEEP_RATE_TOLERANCE=0.30 — cross-day box noise on
+    # a few-second point exceeds RATE_TOLERANCE; see benchguard)
+    slow4 = _sharded(committed_tx_per_sec_shards_4=2300.0 * (1 - 0.31))
     assert any("committed_tx_per_sec_shards_4" in p
                for p in benchguard.guard_shards(slow4, [str(good)]))
+    assert benchguard.guard_shards(
+        _sharded(committed_tx_per_sec_shards_4=2300.0 * (1 - 0.29)),
+        [str(good)]) == []
     # sweep abort-rate blowup breaches the ceiling (tail tolerance 0.5);
     # the guarded field is the SWEEP aggregate, not the flows scenario's
     # cross_shard_abort_rate (a different workload sharing the artifact)

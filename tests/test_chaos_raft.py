@@ -130,6 +130,44 @@ def test_progress_after_leader_kill(seed):
 
 
 @pytest.mark.parametrize("seed", SEEDS)
+def test_leader_kill_records_one_election_episode(seed):
+    """Consensus observatory: a leader-kill window produces EXACTLY one
+    new election episode across the live nodes — split votes extend the
+    same episode rather than inflating the count — and the episode's
+    duration matches the observed re-election gap (the leaderless
+    window an operator sees on /debug/raft)."""
+    import time
+
+    bus, nodes, maps = make_map_cluster(3)
+    leader = run_until_leader(bus, nodes)
+    live = [n for n in nodes if n is not leader]
+    episodes_before = sum(n.stats()["elections_total"] for n in live)
+
+    with inject(*partition_rules(leader.node_id), seed=seed):
+        t0 = time.perf_counter()
+        successor = run_until_leader(bus, nodes, exclude=(leader,))
+        wall_gap = time.perf_counter() - t0
+
+    episodes_after = sum(n.stats()["elections_total"] for n in live)
+    assert episodes_after == episodes_before + 1
+    episode = successor.stats()["elections"][-1]
+    # the kill happened after term 0, so the cause is a timeout (the
+    # votes can all exchange inside one tick's bus pump, so ticks may
+    # legitimately be 0)
+    assert episode["cause"] == "timeout"
+    assert episode["ticks"] >= 0
+    # the episode IS the re-election gap: it opened at the successor's
+    # first candidacy inside the window and closed at leadership, so its
+    # duration is positive and bounded by the measured wall gap
+    assert 0 < episode["duration_s"] <= wall_gap
+    # the observatory surfaces the same episode per group
+    from corda_tpu.observability.consensus_obs import raft_report
+    group = raft_report({"g0": live})["groups"]["g0"]
+    assert group["elections_total"] == episodes_after
+    assert group["leader"]["node"] == successor.node_id
+
+
+@pytest.mark.parametrize("seed", SEEDS)
 def test_commits_survive_append_drop_storm(seed):
     """30% of AppendEntries traffic dropped (seeded): the leader's tick
     resend loop must still drive every entry to commitment on every

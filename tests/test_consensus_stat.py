@@ -1,0 +1,82 @@
+"""consensus_stat CLI: render() is a pure function of the /debug/raft and
+/api/timeseries payloads — canned dicts, no HTTP."""
+from corda_tpu.tools.consensus_stat import render
+
+
+RAFT = {
+    "groups": {
+        "s0": {
+            "leader": {"node": "raft0", "role": "leader", "term": 4,
+                       "leader_tenure_s": 12.5,
+                       "peer_lag": {"raft1": 0, "raft2": 3}},
+            "log_entries": 42, "elections_total": 1,
+            "attribution": {
+                "append_wait": {"n": 9, "p50_ms": 0.1, "p99_ms": 0.4},
+                "fsync": {"n": 9, "p50_ms": 0.2, "p99_ms": 1.4},
+                "replicate": {"n": 9, "p50_ms": 0.8, "p99_ms": 2.1},
+                "apply": {"n": 9, "p50_ms": 0.05, "p99_ms": 0.2},
+                "total": {"n": 9, "p50_ms": 1.15, "p99_ms": 4.1}},
+        },
+        "s1": {"leader": None, "log_entries": 7, "elections_total": 2},
+    },
+    "shards": {
+        "shards": [
+            {"shard": "s0", "requests": 30, "refs": 45,
+             "applied": 28, "reserved": 1},
+            {"shard": "s1", "requests": 10, "refs": 12, "applied": 9},
+        ],
+        "touch_matrix": {"s0": 25, "s0+s1": 5, "s1": 10},
+        "skew_index": 1.5,
+        "coordinator_log_bytes": 2048,
+        "coordinator_in_doubt": 0,
+    },
+}
+
+TIMESERIES = {
+    "columns": ["t", "n", "min", "max", "mean", "last"],
+    "series": {
+        'Raft.LogEntries{group="s0"}': [
+            {"bucket_s": 0.5, "capacity": 240,
+             "points": [[0.0, 2, 1.0, 2.0, 1.5, 2.0],
+                        [0.5, 2, 3.0, 4.0, 3.5, 4.0]]},
+            {"bucket_s": 5.0, "capacity": 240,
+             "points": [[0.0, 4, 1.0, 4.0, 2.5, 4.0]]},
+        ],
+    },
+    "dropped_series": 0,
+}
+
+
+def test_render_groups_and_attribution():
+    screen = render(RAFT, TIMESERIES)
+    lines = screen.splitlines()
+    assert lines[0] == "consensus groups: 2"
+    s0 = next(l for l in lines if l.startswith("s0"))
+    assert "raft0" in s0 and "42" in s0
+    assert "0.2/1.4" in s0        # fsync p50/p99
+    assert "0.8/2.1" in s0        # replicate p50/p99
+    s1 = next(l for l in lines if l.startswith("s1"))
+    # no leader, no attribution: honest "-" cells, never zeros
+    assert "-" in s1 and "7" in s1
+    assert "skew=1.500" in screen
+    assert "coordinator_log_bytes=2048" in screen
+    assert "s0:req=30" in screen and "reserved=1" in screen
+    # the shard without a reserved count renders "-", not 0
+    assert "s1:req=10 applied=9 reserved=-" in screen
+    # sparklines: one per resolution ring with points
+    spark_line = next(l for l in lines if "Raft.LogEntries" in l)
+    assert "|" in spark_line      # two resolutions rendered
+
+
+def test_render_survives_empty_and_malformed():
+    assert "(no raft groups)" in render({}, None)
+    for junk in (None, "oops", 42, {"groups": "x"},
+                 {"groups": {"s0": None}},
+                 {"groups": {"s0": {"leader": "x"}},
+                  "shards": {"shards": "x", "skew_index": None}}):
+        assert render(junk if isinstance(junk, dict) else junk or {},
+                      {"series": "garbage"})
+    # a half-written timeseries payload never breaks the screen
+    broken_ts = {"series": {"x": [{"points": [[1], "junk", None]},
+                                  "garbage"]}}
+    assert render(RAFT, broken_ts)

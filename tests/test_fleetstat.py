@@ -138,6 +138,41 @@ def test_render_controller_block():
         "apply_step(shrink_ladder)" in screen
 
 
+RAFT = {"groups": {
+    "s0": {"leader": {"node": "raft0", "role": "leader",
+                      "leader_tenure_s": 12.5,
+                      "peer_lag": {"raft1": 0, "raft2": 3}},
+           "log_entries": 42, "elections_total": 1,
+           "attribution": {"fsync": {"n": 9, "p50_ms": 0.2,
+                                     "p99_ms": 1.4}}},
+    "s1": {"leader": None, "log_entries": 7, "elections_total": 2},
+}}
+
+
+def test_render_consensus_line():
+    screen = render(FLEET, METRICS, raft=RAFT)
+    line = next(l for l in screen.splitlines()
+                if l.startswith("consensus:"))
+    assert "s0:leader(raft0)" in line
+    assert "tenure=12s" in line or "tenure=13s" in line
+    assert "elections=1" in line
+    assert "fsync_p99=1.4ms" in line
+    assert "lag=3" in line and "log=42" in line
+    # a group mid-election renders honestly: no leader, "-" cells
+    assert "s1:no-leader(?)" in line
+    assert "elections=2" in line and "log=7" in line
+    # no observatory payload (old node): line simply absent
+    assert "consensus:" not in render(FLEET, METRICS)
+
+
+def test_render_consensus_line_survives_garbage():
+    for junk in ("oops", 42, {"groups": "x"}, {"groups": {"s0": None}},
+                 {"groups": {"s0": {"leader": "x", "attribution": 3,
+                                    "log_entries": None}}}):
+        screen = render(FLEET, METRICS, raft=junk)
+        assert "w0" in screen      # worker table still renders
+
+
 def test_render_controller_block_survives_garbage():
     for ctl in ("oops", 42, {"state": None, "ladder": "x",
                              "recent_actions": [None, "bad", {}]}):

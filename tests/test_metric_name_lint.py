@@ -90,6 +90,57 @@ def test_hostile_worker_label_values_survive_escaping():
     assert m is not None
 
 
+def test_consensus_observatory_families_pass_lint():
+    """The Raft.* per-group families (consensus_obs.install_raft_collector)
+    and the Shard.*/CoordinatorLog.* heat families render through
+    prometheus_text under the same grammar as every other family, with
+    group/shard labels intact."""
+    from corda_tpu.observability.consensus_obs import install_raft_collector
+
+    class FakeLeader:
+        def stats(self):
+            return {"role": "leader", "node": "raft0", "term": 4,
+                    "commit_index": 11, "log_entries": 11,
+                    "elections_total": 2, "leader_tenure_s": 3.25,
+                    "peer_lag": {"raft1": 0, "raft2": 3},
+                    "attribution": {
+                        "fsync": {"n": 9, "p50_ms": 0.2, "p99_ms": 1.1},
+                        "replicate": {"n": 9, "p50_ms": 0.5,
+                                      "p99_ms": 2.0}}}
+
+    reg = MetricRegistry()
+    install_raft_collector(reg, lambda: {"s0": [FakeLeader()]})
+    # the sharded provider's heat collector shape (_heat_collect)
+    reg.add_collector(lambda: {
+        "Shard.SkewIndex": {"type": "gauge_fn", "value": 1.5},
+        "CoordinatorLog.Bytes": {"type": "gauge_fn", "value": 4096},
+        "CoordinatorLog.InDoubt": {"type": "gauge_fn", "value": 0},
+        'Shard.Requests{shard="s0"}': {
+            "type": "gauge_fn", "family": "Shard.Requests",
+            "labels": {"shard": "s0"}, "value": 17},
+        'Shard.Reserved{shard="s0"}': {
+            "type": "gauge_fn", "family": "Shard.Reserved",
+            "labels": {"shard": "s0"}, "value": 2},
+    })
+    snap = reg.snapshot()
+    for key in ('Raft.LogEntries{group="s0"}', 'Raft.FsyncP99Ms{group="s0"}',
+                'Raft.ReplLagMax{group="s0"}', "Shard.SkewIndex",
+                'Shard.Requests{shard="s0"}'):
+        assert key in snap, key
+    text = prometheus_text(snap)
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            assert HEADER.match(line), f"malformed header line: {line!r}"
+        else:
+            assert SAMPLE.match(line), f"malformed sample line: {line!r}"
+    assert 'corda_tpu_raft_logentries_value{group="s0"} 11' in text
+    assert 'corda_tpu_raft_repllagmax_value{group="s0"} 3' in text
+    assert 'corda_tpu_shard_requests_value{shard="s0"} 17' in text
+    assert "corda_tpu_coordinatorlog_bytes_value 4096" in text
+
+
 def test_federated_families_render_under_worker_label():
     """The acceptance shape: a worker's SigBatcher.* family appears on the
     node exposition as a labeled sample of ONE family."""

@@ -1,0 +1,96 @@
+"""Retained time-series plane: cascade downsampling, memory bounds, and
+the snapshot contract /api/timeseries serves."""
+from corda_tpu.observability.timeseries import (
+    COLUMNS, TimeSeries, TimeSeriesStore, get_timeseries, set_timeseries)
+
+
+def test_fine_ring_closes_into_coarser():
+    ts = TimeSeries(resolutions=((1.0, 4), (10.0, 4)))
+    # 12 samples, one per second: fine ring (cap 4) evicts; coarse absorbs
+    for i in range(12):
+        ts.record(float(i), float(i))
+    snap = ts.snapshot()
+    fine, coarse = snap
+    assert fine["bucket_s"] == 1.0 and coarse["bucket_s"] == 10.0
+    # fine keeps only its newest buckets (closed cap 4 + the open one)
+    assert len(fine["points"]) <= 5
+    # the evicted fine buckets survive, downsampled, in the coarse ring
+    assert coarse["points"], "cascade lost the evicted buckets"
+    t0_bucket = next(p for p in coarse["points"] if p[0] == 0.0)
+    # columns: t, n, min, max, mean, last — 0..9 landed in the first
+    # coarse bucket (sample 10 opened the next one, closing this)
+    assert t0_bucket[1] == 10
+    assert t0_bucket[2] == 0.0 and t0_bucket[3] == 9.0
+    assert t0_bucket[4] == 4.5 and t0_bucket[5] == 9.0
+
+
+def test_flush_seals_every_resolution():
+    ts = TimeSeries(resolutions=((0.5, 8), (5.0, 8), (60.0, 8)))
+    ts.record(100.0, 7.0)
+    # one sample: every ring holds only an OPEN bucket until flush
+    assert all(not r.closed for r in ts.rings)
+    ts.flush()
+    snap = ts.snapshot()
+    assert all(len(level["points"]) == 1 for level in snap)
+    for level in snap:
+        assert level["points"][0][1] >= 1     # n
+        assert level["points"][0][5] == 7.0   # last
+
+
+def test_old_data_loses_resolution_never_existence():
+    ts = TimeSeries(resolutions=((1.0, 2), (10.0, 2), (100.0, 2)))
+    for i in range(100):
+        ts.record(float(i), 1.0)
+    ts.flush()
+    # the fine rings keep only their newest buckets, but the coarsest
+    # ring (2 buckets × 100 s horizon) still accounts for every sample —
+    # old data lost resolution, not existence
+    coarsest = ts.snapshot()[-1]
+    assert sum(p[1] for p in coarsest["points"]) == 100
+
+
+def test_store_snapshot_contract():
+    store = TimeSeriesStore(resolutions=((1.0, 4), (10.0, 4)))
+    for i in range(6):
+        store.record("a", i, t=float(i))
+        store.record("b", 2 * i, t=float(i))
+    store.record("junk", "not-a-number", t=0.0)   # ignored, no series
+    store.record("junk", None, t=0.0)
+    store.record("junk", True, t=0.0)             # bools are not samples
+    snap = store.snapshot()
+    assert snap["columns"] == list(COLUMNS)
+    assert sorted(snap["series"]) == ["a", "b"]
+    assert snap["dropped_series"] == 0
+    # names filter: unknown names are absent, never an error
+    only_a = store.snapshot(names=["a", "nope"])
+    assert sorted(only_a["series"]) == ["a"]
+    # limit caps points per resolution, newest kept
+    limited = store.snapshot(limit=1)
+    for levels in limited["series"].values():
+        for level in levels:
+            assert len(level["points"]) <= 1
+    rows = limited["series"]["a"][0]["points"]
+    assert rows[0][0] == 5.0    # the newest fine bucket survived the cap
+
+
+def test_store_bounds_series_count():
+    store = TimeSeriesStore(resolutions=((1.0, 2),), max_series=3)
+    for i in range(10):
+        store.record(f"s{i}", 1.0, t=0.0)
+    assert len(store.names()) == 3
+    assert store.dropped_series == 7
+    assert store.snapshot()["dropped_series"] == 7
+    # existing series still record after the cap is hit
+    store.record("s0", 2.0, t=1.0)
+
+
+def test_global_store_seam():
+    mine = TimeSeriesStore()
+    prev = set_timeseries(mine)
+    try:
+        assert get_timeseries() is mine
+        get_timeseries().record("x", 1.0, t=0.0)
+        assert mine.names() == ["x"]
+    finally:
+        set_timeseries(prev)
+    assert get_timeseries() is not mine

@@ -175,6 +175,84 @@ def test_debug_requests_empty_for_in_process_verifier(web):
     assert _get(server, "/debug/requests") == {"requests": {}}
 
 
+def test_debug_raft_empty_for_non_notary(web):
+    network, alice, server = web
+    # alice is not a notary: the observatory answers with empty groups
+    assert _get(server, "/debug/raft") == {"groups": {}}
+
+
+def test_debug_raft_serves_ops_report():
+    class Ops:
+        def raft_report(self):
+            return {"groups": {"s0": {"nodes": [], "leader": None,
+                                      "log_entries": 7,
+                                      "elections_total": 1}}}
+
+    server = NodeWebServer(Ops()).start()
+    try:
+        out = _get(server, "/debug/raft")
+        assert out["groups"]["s0"]["log_entries"] == 7
+    finally:
+        server.stop()
+    # an ops surface without the capability degrades to empty groups
+    bare = NodeWebServer(object()).start()
+    try:
+        assert _get(bare, "/debug/raft") == {"groups": {}}
+    finally:
+        bare.stop()
+
+
+def test_api_timeseries_routes_and_validation():
+    from corda_tpu.observability.timeseries import (TimeSeriesStore,
+                                                    set_timeseries)
+
+    class Ops:
+        def __init__(self, store):
+            self.store = store
+
+        def timeseries_snapshot(self, names=None, limit=None):
+            return self.store.snapshot(names=names, limit=limit)
+
+    store = TimeSeriesStore(resolutions=((1.0, 4), (10.0, 4)))
+    for i in range(12):
+        store.record("Raft.LogEntries", i, t=float(i))
+        store.record("Shard.SkewIndex", 1.0, t=float(i))
+    store.flush()
+    server = NodeWebServer(Ops(store)).start()
+    try:
+        out = _get(server, "/api/timeseries")
+        assert out["columns"] == ["t", "n", "min", "max", "mean", "last"]
+        assert sorted(out["series"]) == ["Raft.LogEntries",
+                                         "Shard.SkewIndex"]
+        levels = out["series"]["Raft.LogEntries"]
+        # ≥2 resolutions of downsampled history (the acceptance shape)
+        assert sum(1 for lvl in levels if lvl["points"]) >= 2
+        # names filter + per-resolution row cap
+        out = _get(server, "/api/timeseries?names=Shard.SkewIndex&limit=2")
+        assert list(out["series"]) == ["Shard.SkewIndex"]
+        assert all(len(lvl["points"]) <= 2
+                   for lvl in out["series"]["Shard.SkewIndex"])
+        # unknown names are absent, never an error
+        out = _get(server, "/api/timeseries?names=nope")
+        assert out["series"] == {}
+        # malformed queries are the client's fault
+        for bad in ("/api/timeseries?limit=zap", "/api/timeseries?limit=0"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(server, bad)
+            assert ei.value.code == 400
+    finally:
+        server.stop()
+    # an ops surface without the capability reads the process-global store
+    prev = set_timeseries(store)
+    bare = NodeWebServer(object()).start()
+    try:
+        out = _get(bare, "/api/timeseries?names=Raft.LogEntries")
+        assert list(out["series"]) == ["Raft.LogEntries"]
+    finally:
+        bare.stop()
+        set_timeseries(prev)
+
+
 def test_debug_requests_serves_request_log():
     from corda_tpu.observability import RequestLog
 
