@@ -72,6 +72,7 @@ SMOKE = "--smoke" in sys.argv
 GUARD = "--guard" in sys.argv
 FLEET = "--fleet" in sys.argv
 LEDGER = "--ledger" in sys.argv
+SOAK = "--soak" in sys.argv
 # smoke: small enough that every per-scheme drain stays below the batcher's
 # host_crossover (192) even when REPS groups coalesce into one flush
 BATCH = int(os.environ.get("CORDA_TPU_BENCH_N", 48 if SMOKE else 32768))
@@ -639,6 +640,102 @@ def ledger_main() -> None:
         print("benchguard: ok", file=sys.stderr)
 
 
+def soak_main() -> None:
+    """--soak: the drift-gated endurance run (ISSUE 19). Smoke: ~20 s of
+    real load with every soak cadence accelerated (5 s phases, recurring
+    chaos every 6 s) so tier-1 proves the full artifact schema — phase
+    series, per-structure leak verdicts, subsystem CPU shares, drift
+    slopes, mid-run invariant re-checks — without the wall clock. Full:
+    ≥10 minutes at steady offered load over the sharded notary with
+    chaos recurring on its schedule; emits the SOAK_r0*.json fields.
+
+    Validity probes (BENCH INVALID, any shape): a ``leaking`` verdict on
+    any declared-bounded structure, a failed mid-run invariant re-check,
+    a missing schema field. Full runs additionally enforce the drift
+    gates (throughput/p99 slope vs the declared bounds) and the CPU
+    attribution sanity band (shares sum 90–110% of busy samples, a named
+    top commit-path consumer) — a ~20 s smoke window is far too noisy
+    for slope fits, exactly the existing smoke-vs-full benchguard
+    discipline."""
+    from corda_tpu.observability.soak import SoakConfig, run_soak
+
+    minutes = 10.0
+    if "--minutes" in sys.argv:
+        i = sys.argv.index("--minutes")
+        if i + 1 < len(sys.argv):
+            minutes = float(sys.argv[i + 1])
+    cfg = SoakConfig.smoke() if SMOKE else SoakConfig(minutes=minutes)
+    out = run_soak(cfg)
+    out.pop("trace_sample", None)
+    out["ledger"] = True
+    out["soak"] = True
+    if SMOKE:
+        out["smoke"] = True
+    print(json.dumps(out))
+
+    problems = []
+    from corda_tpu.tools.benchguard import SOAK_REQUIRED
+    missing = [k for k in SOAK_REQUIRED if k not in out]
+    if missing:
+        problems.append(f"soak artifact missing fields: {missing}")
+    if not out.get("exactly_once_ok"):
+        problems.append("exactly-once violated at quiescence")
+    if not out.get("replicas_agree"):
+        problems.append("raft replicas diverged at quiescence")
+    if not out.get("soak_invariant_ok"):
+        bad = [c for c in out.get("soak_invariant_checks", [])
+               if not c.get("ok")]
+        problems.append(f"mid-run invariant re-check failed: {bad}")
+    if out.get("soak_leaking"):
+        for name in out["soak_leaking"]:
+            v = out["soak_leak_verdicts"].get(name, {})
+            problems.append(
+                f"leak verdict on declared-bounded structure {name}: "
+                f"slope {v.get('slope_per_s')}/s, projected doubling "
+                f"{v.get('doubling_s')}s")
+    missing_verdicts = [n for n, v in
+                        out.get("soak_leak_verdicts", {}).items()
+                        if v.get("verdict") not in
+                        ("bounded", "growing", "leaking")]
+    if missing_verdicts:
+        problems.append(f"structures without a leak verdict: "
+                        f"{missing_verdicts}")
+    if out.get("soak_cpu_samples", 0) < 1:
+        problems.append("CPU profiler took no samples")
+    if len(out.get("soak_phases", [])) < 2:
+        problems.append("fewer than 2 soak phases sealed")
+    if out.get("soak_chaos_cycles", 0) < 1:
+        problems.append("no recurring chaos window ran")
+    if not SMOKE:
+        cpu_sum = out.get("soak_cpu_share_sum_pct", 0.0)
+        if not 90.0 <= cpu_sum <= 110.0:
+            problems.append(f"CPU shares sum to {cpu_sum}% of sampled "
+                            "busy time (want 90–110%)")
+        if not out.get("soak_cpu_top_commit_path"):
+            problems.append("no top commit-path CPU consumer attributed")
+        if not out.get("soak_drift_ok"):
+            problems.append(
+                "drift gate breached: throughput slope "
+                f"{out.get('soak_throughput_slope_pct_per_min')}%/min "
+                f"(gate ≥ {out.get('soak_throughput_gate_pct_per_min')}), "
+                f"p99 slope {out.get('soak_p99_slope_pct_per_min')}%/min "
+                f"(gate ≤ {out.get('soak_p99_gate_pct_per_min')})")
+    if problems:
+        for p in problems:
+            print(f"BENCH INVALID: {p}", file=sys.stderr)
+        sys.exit(1)
+    if GUARD:
+        from corda_tpu.tools.benchguard import guard_soak
+        failures = guard_soak(out)
+        if failures:
+            print("BENCH REGRESSION: soak metrics breached their "
+                  "trajectory floors:", file=sys.stderr)
+            for p in failures:
+                print(f"  {p}", file=sys.stderr)
+            sys.exit(1)
+        print("benchguard: ok", file=sys.stderr)
+
+
 def main() -> None:
     from corda_tpu.observability import get_profiler
     from corda_tpu.verifier.batcher import SignatureBatcher
@@ -745,6 +842,8 @@ def main() -> None:
 if __name__ == "__main__":
     if FLEET:
         fleet_main()
+    elif SOAK:
+        soak_main()
     elif LEDGER:
         ledger_main()
     else:

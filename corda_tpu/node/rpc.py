@@ -355,15 +355,30 @@ class CordaRPCOps:
                     groups["s0"] = [raft]
         return consensus_obs.raft_report(groups, sharded=sharded)
 
-    def timeseries_snapshot(self, names=None, limit: int | None = None
-                            ) -> dict:
+    def timeseries_snapshot(self, names=None, limit: int | None = None,
+                            since: float | None = None,
+                            resolution: float | None = None) -> dict:
         """Retained time-series plane for /api/timeseries: downsampled
         multi-resolution history of the consensus gauges sampled by the
         raft pump (observability/timeseries.py). ``names`` filters to
-        specific series, ``limit`` caps rows per resolution. Well-formed
-        and empty when nothing has been recorded."""
+        specific series, ``limit`` caps rows per resolution, ``since``
+        drops buckets starting before that epoch time and ``resolution``
+        keeps only the ring with that bucket width (the soak poller's
+        incremental-fetch filters). Well-formed and empty when nothing
+        has been recorded."""
         from ..observability import get_timeseries
-        return get_timeseries().snapshot(names=names, limit=limit)
+        return get_timeseries().snapshot(names=names, limit=limit,
+                                         since=since, resolution=resolution)
+
+    def soak_report(self) -> dict:
+        """Soak observatory for /debug/soak: every structure registered
+        with the resource accounting plane — live size, declared kind
+        (bounded vs grows-by-design), leak verdict over its retained
+        ``Resource.*`` series — plus the subsystem CPU-attribution
+        snapshot when a profiler is active (observability/soak.py).
+        Well-formed and empty on a node with no registered probes."""
+        from ..observability.soak import soak_report
+        return soak_report()
 
     def vault_feed(self, state_type: type | None = None) -> DataFeed:
         def subscribe(cb):

@@ -30,6 +30,12 @@ Three pieces (docs/OBSERVABILITY.md):
 - consensus_obs.py — the consensus observatory: raft stats pooling
   (/debug/raft), Raft.* metric families, growth watchdogs, and the
   ``ledger_raft_*`` artifact fields.
+- resprof.py — the resource accounting plane (per-structure size probes
+  → ``Resource.*`` series → ``bounded | growing | leaking`` verdicts)
+  and the subsystem CPU sampling profiler.
+- soak.py — drift-gated endurance runs: recurring chaos, per-phase
+  committed-rate/tail/budget series, mid-run invariant re-checks, the
+  ``soak_*`` artifact fields and /debug/soak payload.
 
 The Histogram metric type itself lives in utils/metrics.py with the rest
 of the registry.
@@ -44,8 +50,13 @@ from .federation import FleetMetricsFederation
 from .lifecycle import RequestLog
 from .profiling import (KernelProfiler, OverlapTracker, get_profiler,
                         set_profiler)
+from .resprof import (COMMIT_PATH_COMPONENTS, CPU_COMPONENTS,
+                      ResourceRegistry, SubsystemProfiler, classify_stack,
+                      get_resources, leak_verdict, process_rss_bytes,
+                      set_resources, theil_sen_slope)
 from .ring import SpanRing
 from .slog import jlog
+from .soak import SoakConfig, SoakObserver, run_soak, soak_report
 from .slo import DEFAULT_OBJECTIVES, SLObjective, SLOTracker
 from .stages import (LEDGER_STAGE_METRICS, STAGE_METRICS,
                      ledger_stage_percentiles, stage_percentiles)
@@ -56,18 +67,25 @@ from .tracing import (NOOP_SPAN, NOOP_TRACER, NoopTracer, Span, SpanContext,
                       make_span_dict, set_tracer)
 
 __all__ = [
-    "ATTRIBUTION_COMPONENTS", "COMPONENTS", "DEFAULT_OBJECTIVES",
+    "ATTRIBUTION_COMPONENTS", "COMMIT_PATH_COMPONENTS", "COMPONENTS",
+    "CPU_COMPONENTS", "DEFAULT_OBJECTIVES",
     "FleetMetricsFederation", "GrowthWatch",
     "KernelProfiler", "LEDGER_STAGE_METRICS", "NOOP_SPAN", "NOOP_TRACER",
-    "NoopTracer", "OverlapTracker", "RequestLog", "SLObjective",
-    "SLOTracker", "Span", "SpanContext", "SpanRing", "STAGE_METRICS",
+    "NoopTracer", "OverlapTracker", "RequestLog", "ResourceRegistry",
+    "SLObjective",
+    "SLOTracker", "SoakConfig", "SoakObserver", "Span", "SpanContext",
+    "SpanRing", "STAGE_METRICS", "SubsystemProfiler",
     "TimeSeries", "TimeSeriesStore",
-    "Tracer", "WAIT_KINDS", "aggregate_critpaths", "component_of",
+    "Tracer", "WAIT_KINDS", "aggregate_critpaths", "classify_stack",
+    "component_of",
     "critical_path", "critpath_report", "disable_tracing",
-    "enable_tracing", "flow_kind", "get_profiler", "get_timeseries",
-    "get_tracer", "install_raft_collector", "jlog",
+    "enable_tracing", "flow_kind", "get_profiler", "get_resources",
+    "get_timeseries",
+    "get_tracer", "install_raft_collector", "jlog", "leak_verdict",
     "ledger_critpath_fields", "ledger_raft_fields",
-    "ledger_stage_percentiles", "make_span_dict", "raft_report",
-    "sample_timeseries", "set_profiler", "set_timeseries", "set_tracer",
-    "stage_percentiles",
+    "ledger_stage_percentiles", "make_span_dict", "process_rss_bytes",
+    "raft_report", "run_soak",
+    "sample_timeseries", "set_profiler", "set_resources",
+    "set_timeseries", "set_tracer", "soak_report",
+    "stage_percentiles", "theil_sen_slope",
 ]

@@ -265,10 +265,18 @@ class GrowthWatch:
 
 def sample_timeseries(store, groups: dict, sharded=None,
                       watch: GrowthWatch | None = None,
-                      t: float | None = None) -> dict:
+                      t: float | None = None, resources=None) -> dict:
     """One periodic sampling tick: record the soak-relevant consensus
     gauges into the retained time-series plane and (optionally) feed the
-    growth watchdog. Returns {series name: value} for what was recorded."""
+    growth watchdog. Returns {series name: value} for what was recorded.
+
+    ``resources`` is an optional :class:`~.resprof.ResourceRegistry`:
+    when given, every structure registered with the resource accounting
+    plane is sampled in the same tick (``Resource.*`` series) and fed
+    through the SAME watchdog — any registered probe gets doubling
+    warnings for free, while the two historical hazards below keep their
+    exact jlog series names (`Raft.LogEntries{...}`/`CoordinatorLog.Bytes`)
+    so existing log pipelines stay byte-compatible."""
     values: dict = {}
     for label, nodes in (groups or {}).items():
         node_stats = [s for s in (_node_stats(n) for n in nodes)
@@ -297,6 +305,11 @@ def sample_timeseries(store, groups: dict, sharded=None,
         watch.observe_many({k: v for k, v in values.items()
                             if k.startswith("Raft.LogEntries")
                             or k == "CoordinatorLog.Bytes"})
+    if resources is not None:
+        try:
+            values.update(resources.sample(store=store, watch=watch, t=t))
+        except Exception:
+            pass   # a broken probe must not stall the consensus sampler
     return values
 
 

@@ -135,6 +135,14 @@ class LedgerScenarioConfig:
     #: coins), so their input refs straddle shards with probability
     #: (shards-1)/shards — the cross-shard 2PC traffic mix.
     cross_shard_pct: float = 0.0
+    #: optional run observer (ISSUE 19 soak mode): an object offering any
+    #: of ``on_start(ctx)`` (topology dict, after the schedulers exist),
+    #: ``on_tick(now_rel)`` (every driver iteration, driver thread),
+    #: ``on_drain(end_rel)`` (workload drained, before invariants),
+    #: ``finalize(report)`` (mutate the report before return) and
+    #: ``close()`` (finally-block teardown). All calls are best-effort —
+    #: a raising observer never kills the run.
+    observer: object = None
 
     @staticmethod
     def full(seed: int = 7, chaos: bool = True) -> "LedgerScenarioConfig":
@@ -529,6 +537,23 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
         next_i = 0
         started = time.monotonic()
 
+        observer = cfg.observer
+        if observer is not None and hasattr(observer, "on_start"):
+            # the soak observer's view of the topology: size probes hang
+            # off these live objects, invariant re-checks walk the shard
+            # machines, phase seals read the workload bookkeeping (safe —
+            # on_tick runs on this same driver thread)
+            observer.on_start({
+                "cfg": cfg, "network": network, "verifier": verifier,
+                "raft_nodes": raft_nodes, "raft_groups": raft_groups,
+                "shard_machines": shard_machines, "machines": machines,
+                "n_shards": n_shards, "sharded": sharded_ref["provider"],
+                "uniq_provider": uniq_provider, "ts_store": ts_store,
+                "growth": growth, "slo": slo,
+                "committed_notarised": committed_notarised,
+                "latencies": latencies, "final_counts": final_counts,
+                "started": started})
+
         def _node_for(op: _Op):
             return bank if op.kind == "issue" else parties[op.initiator]
 
@@ -636,6 +661,11 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
                 break
             if chaos is not None:
                 chaos.tick(now_rel)
+            if observer is not None and hasattr(observer, "on_tick"):
+                try:
+                    observer.on_tick(now_rel)
+                except Exception:
+                    pass   # observability must never stall the workload
             while next_i < len(ops) and ops[next_i].intended_s <= now_rel:
                 _launch(ops[next_i])
                 next_i += 1
@@ -661,6 +691,11 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
             inflight.remove(op)
             _finish(op, end_rel, False, err="unfinished at scenario end")
         duration_s = time.monotonic() - started
+        if observer is not None and hasattr(observer, "on_drain"):
+            try:
+                observer.on_drain(end_rel)
+            except Exception:
+                pass
 
         # -- deliberate double-spend replays (hot-state preset) ---------------
         ds_attempted = ds_rejected = 0
@@ -875,9 +910,17 @@ def run_ledger_scenario(cfg: LedgerScenarioConfig | None = None) -> dict:
             report["double_spend_rejection_rate"] = (
                 round(ds_rejected / ds_attempted, 4) if ds_attempted
                 else 0.0)
+        if observer is not None and hasattr(observer, "finalize"):
+            observer.finalize(report)
         return report
     finally:
         faults.disarm()
+        obs = cfg.observer
+        if obs is not None and hasattr(obs, "close"):
+            try:
+                obs.close()
+            except Exception:
+                pass
         if n_shards > 1:
             try:
                 # shuts the 2PC coordinator pool down before the per-replica

@@ -82,6 +82,13 @@ class RequestLog:
         self._timelines.popitem(last=False)
         self.dropped += 1
 
+    def __len__(self) -> int:
+        """Live timeline count — the bounded size the resource accounting
+        plane probes (``Requests.Timelines``); ``dropped`` is the matching
+        cumulative eviction counter it differentiates into a rate."""
+        with self._lock:
+            return len(self._timelines)
+
     def timeline(self, vid: int) -> list[dict]:
         with self._lock:
             return list(self._timelines.get(vid, ()))

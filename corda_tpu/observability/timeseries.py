@@ -128,10 +128,22 @@ class TimeSeries:
                 self.rings[i + 1].add(closed.start, closed.n, closed.vmin,
                                       closed.vmax, closed.total, closed.last)
 
-    def snapshot(self, limit: int | None = None) -> list:
+    def snapshot(self, limit: int | None = None,
+                 since: float | None = None,
+                 resolution: float | None = None) -> list:
+        """``since`` keeps only buckets starting at/after that absolute
+        time (an incremental poller sends its last-seen ``t``; a bucket
+        straddling the cutoff while still open reappears, sealed, in the
+        next poll — at-least-once, never silently dropped). ``resolution``
+        keeps only the ring whose ``bucket_s`` matches; an unknown value
+        matches nothing and returns an empty list rather than erroring."""
         out = []
         for ring in self.rings:
+            if resolution is not None and ring.bucket_s != resolution:
+                continue
             rows = ring.rows()
+            if since is not None:
+                rows = [r for r in rows if r[0] >= since]
             if limit is not None and len(rows) > limit:
                 rows = rows[-limit:]
             out.append({"bucket_s": ring.bucket_s,
@@ -179,16 +191,22 @@ class TimeSeriesStore:
         with self._lock:
             return sorted(self._series)
 
-    def snapshot(self, names=None, limit: int | None = None) -> dict:
+    def snapshot(self, names=None, limit: int | None = None,
+                 since: float | None = None,
+                 resolution: float | None = None) -> dict:
         """{"columns": COLUMNS, "series": {name: [{bucket_s, capacity,
         points: [[t, n, min, max, mean, last], ...]}, ...]}} — resolutions
         finest-first; ``limit`` caps points per resolution (most recent
-        kept). Unknown requested names are simply absent, never an error."""
+        kept), ``since`` drops buckets starting before that absolute time,
+        ``resolution`` keeps only the matching ring (soak pollers ask for
+        the 60 s ring alone). Unknown requested names are simply absent,
+        never an error."""
         with self._lock:
             wanted = sorted(self._series) if names is None else \
                 [n for n in names if n in self._series]
-            series = {n: self._series[n].snapshot(limit=limit)
-                      for n in wanted}
+            series = {n: self._series[n].snapshot(
+                limit=limit, since=since, resolution=resolution)
+                for n in wanted}
         return {"columns": list(COLUMNS), "series": series,
                 "dropped_series": self.dropped_series}
 

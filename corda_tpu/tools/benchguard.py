@@ -773,6 +773,145 @@ def guard_hot_state(current: dict,
     return problems
 
 
+# ---------------------------------------------------------------------------
+# SOAK (endurance run) gate — ISSUE 19
+# ---------------------------------------------------------------------------
+
+#: Fields a soak artifact must carry on top of the LEDGER base
+#: (bench.py --soak / tools/scenario.py --soak): the phase series, the
+#: per-structure leak verdicts, the subsystem CPU attribution, the drift
+#: slopes against their declared gates, and the mid-run invariant
+#: re-check ledger. The tier-1 smoke soak asserts exactly this shape.
+SOAK_REQUIRED: tuple = (
+    "soak", "soak_minutes", "soak_phase_s", "soak_phases",
+    "soak_chaos_cycles", "soak_chaos_windows", "soak_resources",
+    "soak_leak_verdicts", "soak_leaking", "soak_leak_ok",
+    "soak_invariant_checks", "soak_invariant_recheck_count",
+    "soak_invariant_ok",
+    "soak_cpu_shares_pct", "soak_cpu_share_sum_pct", "soak_cpu_samples",
+    "soak_cpu_busy_frac", "soak_cpu_top_commit_path",
+    "soak_spans_dropped_rate_per_s", "soak_timeline_evictions_rate_per_s",
+    "soak_throughput_slope_pct_per_min", "soak_p99_slope_pct_per_min",
+    "soak_throughput_gate_pct_per_min", "soak_p99_gate_pct_per_min",
+    "soak_drift_ok",
+    "committed_tx_per_sec", "exactly_once_ok", "replicas_agree",
+)
+
+#: non-numeric SOAK_REQUIRED fields (shape-checked individually)
+_SOAK_FIELD_TYPES: dict = {
+    "soak": bool, "soak_phases": list, "soak_chaos_windows": list,
+    "soak_resources": dict, "soak_leak_verdicts": dict,
+    "soak_leaking": list, "soak_leak_ok": bool,
+    "soak_invariant_checks": list, "soak_invariant_ok": bool,
+    "soak_cpu_shares_pct": dict, "soak_cpu_top_commit_path": str,
+    "soak_drift_ok": bool, "exactly_once_ok": bool, "replicas_agree": bool,
+}
+
+
+def soak_trajectory_paths(root: str | None = None) -> list[str]:
+    root = root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return sorted(_glob.glob(os.path.join(root, "SOAK_r*.json")))
+
+
+def guard_soak(current: dict,
+               trajectory_paths: list[str] | None = None) -> list[str]:
+    """The endurance-run gate. HARD invariants regardless of smoke: the
+    full soak schema present and well-typed, no ``leaking`` verdict on
+    any declared-bounded structure, every mid-run invariant re-check
+    passed (and at least one ran), quiescence invariants held, at least
+    one recurring chaos cycle completed, and every registered structure
+    carries a verdict. Full runs additionally enforce the drift gates
+    (the artifact's self-declared slope bounds), the CPU-share sanity
+    band (90–110% with a named top commit-path consumer), and the
+    best-so-far committed-rate floor from the SOAK trajectory — a ~20 s
+    smoke window is far too noisy for slope fits or rate floors, the
+    same smoke-vs-full discipline as every other family."""
+    current = parse_artifact(current)
+    problems = []
+    for name in SOAK_REQUIRED:
+        if name not in current:
+            problems.append(f"missing required soak field {name!r}")
+            continue
+        want = _SOAK_FIELD_TYPES.get(name)
+        if want is not None:
+            if not isinstance(current[name], want):
+                problems.append(
+                    f"{name} should be a {want.__name__}, got "
+                    f"{type(current[name]).__name__}")
+        elif (isinstance(current[name], bool)
+              or not isinstance(current[name], (int, float))):
+            problems.append(f"{name} should be a number, got "
+                            f"{type(current[name]).__name__}")
+    if problems:
+        return problems
+    verdicts = current["soak_leak_verdicts"]
+    bad_verdicts = [n for n, v in verdicts.items()
+                    if not isinstance(v, dict)
+                    or v.get("verdict") not in
+                    ("bounded", "growing", "leaking")]
+    if bad_verdicts:
+        problems.append(f"structures without a well-formed leak verdict: "
+                        f"{sorted(bad_verdicts)}")
+    if not verdicts:
+        problems.append("no structure registered a leak verdict")
+    if current["soak_leaking"] or not current["soak_leak_ok"]:
+        problems.append(
+            f"leak verdict on declared-bounded structures: "
+            f"{current['soak_leaking']}")
+    if current["soak_invariant_recheck_count"] < 1:
+        problems.append("no mid-run invariant re-check ran")
+    if not current["soak_invariant_ok"]:
+        problems.append("a mid-run invariant re-check failed")
+    if not current["exactly_once_ok"]:
+        problems.append("exactly_once_ok is false at quiescence")
+    if not current["replicas_agree"]:
+        problems.append("replicas_agree is false at quiescence")
+    if current["soak_chaos_cycles"] < 1:
+        problems.append("no recurring chaos cycle ran")
+    if len(current["soak_phases"]) < 2:
+        problems.append(f"only {len(current['soak_phases'])} soak "
+                        "phase(s) sealed (want >= 2)")
+    if current["soak_cpu_samples"] < 1:
+        problems.append("CPU profiler took no samples")
+    if current.get("smoke") or str(current.get("mode", "")).endswith("smoke"):
+        return problems
+    cpu_sum = current["soak_cpu_share_sum_pct"]
+    if not 90.0 <= cpu_sum <= 110.0:
+        problems.append(f"soak_cpu_share_sum_pct={cpu_sum} outside the "
+                        "90–110% sanity band")
+    if not current["soak_cpu_top_commit_path"]:
+        problems.append("no top commit-path CPU consumer attributed")
+    if not current["soak_drift_ok"]:
+        problems.append(
+            "drift gate breached: throughput slope "
+            f"{current['soak_throughput_slope_pct_per_min']}%/min "
+            f"(gate >= {current['soak_throughput_gate_pct_per_min']}), "
+            f"p99 slope {current['soak_p99_slope_pct_per_min']}%/min "
+            f"(gate <= {current['soak_p99_gate_pct_per_min']})")
+    paths = (soak_trajectory_paths() if trajectory_paths is None
+             else trajectory_paths)
+    best = 0.0
+    for path in sorted(paths):
+        with open(path, encoding="utf-8") as f:
+            run = parse_artifact(json.load(f))
+        if run.get("smoke") or str(run.get("mode", "")).endswith("smoke") \
+                or not same_host_class(run, current):
+            continue
+        v = run.get("committed_tx_per_sec")
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            best = max(best, v)
+    if best > 0:
+        floor = best * (1 - RATE_TOLERANCE)
+        v = current["committed_tx_per_sec"]
+        if v < floor:
+            problems.append(
+                f"committed_tx_per_sec: {v:g} < floor {floor:.4g} "
+                f"(best {best:g} - {RATE_TOLERANCE:.0%} tolerance; "
+                f"higher is better)")
+    return problems
+
+
 def guard_current(current: dict, trajectory_paths: list[str] | None = None
                   ) -> list[str]:
     """The bench.py --guard entry: fit guards from the repo trajectory and

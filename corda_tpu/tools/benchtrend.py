@@ -52,6 +52,15 @@ FAMILIES = {
                 "ledger_raft_fsync_ms_p99",
                 "ledger_raft_replicate_ms_p99",
                 "ledger_shard_skew_index")),
+    # soak observatory (ISSUE 19): endurance rounds. Every column is
+    # tolerant of pre-soak artifacts — a missing field renders "-".
+    "soak": (benchguard.soak_trajectory_paths,
+             ("committed_tx_per_sec", "soak_minutes",
+              "soak_throughput_slope_pct_per_min",
+              "soak_p99_slope_pct_per_min", "soak_drift_ok",
+              "soak_leak_ok", "soak_invariant_ok",
+              "soak_cpu_top_commit_path", "soak_cpu_share_sum_pct",
+              "soak_chaos_cycles")),
 }
 
 _ROUND_RE = re.compile(r"_r(\d+)\.json$")

@@ -81,10 +81,54 @@ def _sparkline(points) -> str:
         for v in means)
 
 
-def render(raft: dict, timeseries: dict | None = None) -> str:
+def _soak_lines(soak) -> list:
+    """The soak-observatory section: one row per registered structure
+    (size, declared kind, leak verdict, slope) and the subsystem CPU
+    shares when a profiler is running. Empty list when the payload is
+    absent/malformed — a node without the soak plane just loses the
+    section, never the screen."""
+    if not isinstance(soak, dict):
+        return []
+    resources = soak.get("resources")
+    lines: list = []
+    if isinstance(resources, dict) and resources:
+        lines.append("soak resources (size / kind / verdict):")
+        for name in sorted(resources, key=str):
+            r = resources[name]
+            if not isinstance(r, dict):
+                continue
+            verdict = _cell(r.get("verdict"), "-")
+            slope = r.get("slope_per_s")
+            slope_txt = f" {slope:+.3g}/s" \
+                if isinstance(slope, (int, float)) \
+                and not isinstance(slope, bool) and slope else ""
+            flag = " !!" if verdict == "leaking" else ""
+            lines.append(
+                f"  {str(name):<28}{_cell(r.get('size'), '-'):>14}"
+                f"  {_cell(r.get('kind'), '-'):<8}"
+                f"{verdict}{slope_txt}{flag}")
+    cpu = soak.get("cpu")
+    if isinstance(cpu, dict):
+        shares = cpu.get("shares_pct")
+        if isinstance(shares, dict) and shares:
+            cells = [f"{k}={v:.1f}%" for k, v in
+                     sorted(shares.items(), key=lambda kv: -kv[1])
+                     if isinstance(v, (int, float))
+                     and not isinstance(v, bool) and v > 0]
+            if cells:
+                top = _cell(cpu.get("top_commit_path"), "-")
+                lines.append(f"cpu shares (busy, top commit-path: {top}): "
+                             + "  ".join(cells))
+    return lines
+
+
+def render(raft: dict, timeseries: dict | None = None,
+           soak: dict | None = None) -> str:
     """One screenful: a row per raft group, the shard heat table when the
-    notary shards, and a sparkline per retained time series. Pure function
-    of the JSON payloads — tolerates empty and malformed ones."""
+    notary shards, a sparkline per retained time series, and the soak
+    observatory section (resource verdicts + CPU shares) when the node
+    serves /debug/soak. Pure function of the JSON payloads — tolerates
+    empty and malformed ones."""
     if not isinstance(raft, dict):
         raft = {}
     groups = raft.get("groups")
@@ -161,6 +205,7 @@ def render(raft: dict, timeseries: dict | None = None) -> str:
                 for r in rings) if s]
             if sparks:
                 lines.append(f"  {name:<36} " + " | ".join(sparks))
+    lines.extend(_soak_lines(soak))
     return "\n".join(lines)
 
 
@@ -188,7 +233,13 @@ def main(argv=None) -> int:
             timeseries = fetch(args.url, "/api/timeseries")
         except Exception:
             timeseries = None
-        screen = render(raft, timeseries)
+        try:
+            # optional surface: /debug/soak (resource verdicts + CPU
+            # shares) — a node without the soak plane loses the section
+            soak = fetch(args.url, "/debug/soak")
+        except Exception:
+            soak = None
+        screen = render(raft, timeseries, soak)
         if args.once:
             print(screen)
             return 0

@@ -64,7 +64,7 @@ def _cell(value, default):
 
 
 def render(fleet: dict, metrics: dict, critpath: dict | None = None,
-           raft: dict | None = None) -> str:
+           raft: dict | None = None, soak: dict | None = None) -> str:
     """One screenful: fleet header + a row per worker, plus (when the
     node answers /debug/critpath) one tail-forensics line per flow class:
     the dominant blame component and its p50 share. Pure function of the
@@ -211,6 +211,25 @@ def render(fleet: dict, metrics: dict, critpath: dict | None = None,
             parts.append(f"{kind}={_cell(dom, '?')}{pct}")
         if parts:
             lines.append("critpath blame(p50): " + "  ".join(parts))
+    # soak observatory (ISSUE 19): one line from /debug/soak — leak
+    # verdict summary over the registered structures plus the top
+    # commit-path CPU consumer when a profiler is running. A node
+    # without the soak plane just loses the line.
+    resources = soak.get("resources") if isinstance(soak, dict) else None
+    if isinstance(resources, dict) and resources:
+        leaking = soak.get("leaking")
+        leaking = leaking if isinstance(leaking, (list, tuple)) else []
+        growing = sum(1 for r in resources.values()
+                      if isinstance(r, dict)
+                      and r.get("verdict") == "growing")
+        cpu = soak.get("cpu") if isinstance(soak.get("cpu"), dict) else {}
+        top = cpu.get("top_commit_path")
+        lines.append(
+            f"soak: {len(resources)} structures"
+            f" leaking={len(leaking)}"
+            + (f"{sorted(leaking)}" if leaking else "")
+            + f" growing={growing}"
+            + (f"  cpu_top={top}" if isinstance(top, str) and top else ""))
     return "\n".join(lines)
 
 
@@ -244,7 +263,13 @@ def main(argv=None) -> int:
             raft = fetch(args.url, "/debug/raft")
         except Exception:
             raft = None
-        screen = render(fleet, metrics, critpath, raft)
+        try:
+            # optional surface: a node without the soak observatory just
+            # loses the soak line
+            soak = fetch(args.url, "/debug/soak")
+        except Exception:
+            soak = None
+        screen = render(fleet, metrics, critpath, raft, soak)
         if args.once:
             print(screen)
             return 0

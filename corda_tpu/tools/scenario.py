@@ -8,6 +8,14 @@ report as JSON — the same fields ``bench.py --ledger`` emits into
     python -m corda_tpu.tools.scenario                  # smoke shape
     python -m corda_tpu.tools.scenario --full --chaos   # measured shape
     python -m corda_tpu.tools.scenario --parties 12 --ops 120 --rate 20
+    python -m corda_tpu.tools.scenario --soak 10        # 10-min endurance
+
+``--soak MINUTES`` runs the drift-gated endurance preset instead
+(observability/soak.py): steady offered load over the sharded notary
+with chaos recurring on a schedule, per-minute phase segments, resource
+leak verdicts, subsystem CPU attribution and mid-run invariant
+re-checks. It exits 1 on ANY leak verdict, drift-gate breach or
+invariant failure, printing the repro seed line on the way out.
 
 Exit status is non-zero when the run violated the ledger invariant
 (exactly-once / replica agreement) so CI can gate on it directly.
@@ -19,9 +27,7 @@ import json
 import sys
 
 
-def build_config(argv=None):
-    from ..observability.ledger_harness import LedgerScenarioConfig
-
+def _parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="corda_tpu.tools.scenario",
         description="open-loop ledger scenario runner")
@@ -45,6 +51,12 @@ def build_config(argv=None):
                     help="fraction of payments forced multi-coin so their "
                          "inputs straddle shards (default 0.35 with "
                          "--shards)")
+    ap.add_argument("--soak", type=float, default=None, metavar="MINUTES",
+                    help="endurance preset: MINUTES of steady load over "
+                         "the sharded notary with recurring chaos, leak "
+                         "verdicts, CPU attribution and drift gates; "
+                         "exits 1 on any leak / drift breach / invariant "
+                         "failure")
     ap.add_argument("--parties", type=int, default=None)
     ap.add_argument("--ops", type=int, default=None,
                     help="total operations (issue ops included)")
@@ -53,7 +65,13 @@ def build_config(argv=None):
     ap.add_argument("--seed", type=int, default=None)
     ap.add_argument("--timeout", type=float, default=None,
                     help="uniqueness-provider commit timeout (seconds)")
-    args = ap.parse_args(argv)
+    return ap
+
+
+def build_config(argv=None):
+    from ..observability.ledger_harness import LedgerScenarioConfig
+
+    args = _parser().parse_args(argv)
 
     if args.shards is not None and args.shards > 1:
         cfg = LedgerScenarioConfig.sharded(
@@ -82,8 +100,52 @@ def build_config(argv=None):
     return cfg
 
 
+def soak_main(args) -> int:
+    """The --soak preset: run the endurance scenario and hold it to the
+    full soak gate (tools/benchguard.guard_soak — leak verdicts, drift
+    gates, mid-run invariant re-checks, CPU sanity). Exit 1 on any
+    breach, with the repro seed line printed to stderr so the failure is
+    replayable (the chaos schedule, workload mix and fault decisions are
+    all derived from the one seed)."""
+    from ..observability.soak import SoakConfig, run_soak
+    from .benchguard import guard_soak
+
+    cfg = SoakConfig(minutes=args.soak)
+    if args.seed is not None:
+        cfg.seed = args.seed
+    if args.rate is not None:
+        cfg.rate_tx_per_sec = args.rate
+    if args.parties is not None:
+        cfg.parties = args.parties
+    if args.shards is not None:
+        cfg.shards = max(1, args.shards)
+    if args.cross_shard_pct is not None:
+        cfg.cross_shard_pct = args.cross_shard_pct
+    if args.timeout is not None:
+        cfg.provider_timeout_s = args.timeout
+    report = run_soak(cfg)
+    report.pop("trace_sample", None)
+    print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    problems = guard_soak(report)
+    if problems:
+        for p in problems:
+            print(f"SOAK FAILED: {p}", file=sys.stderr)
+        # the chaos conftest repro discipline: one seed reproduces the
+        # workload mix, the recurring chaos schedule and every fault
+        # decision inside the windows
+        print(f"soak seed {cfg.seed} — reproduce with "
+              f"python -m corda_tpu.tools.scenario --soak {args.soak:g} "
+              f"--seed {cfg.seed}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     from ..observability.ledger_harness import run_ledger_scenario
+
+    args = _parser().parse_args(argv)
+    if args.soak is not None:
+        return soak_main(args)
 
     report = run_ledger_scenario(build_config(argv))
     print(json.dumps(report, indent=2, sort_keys=True, default=str))

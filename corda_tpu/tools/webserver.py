@@ -332,6 +332,13 @@ class NodeWebServer:
                     except Exception as e:
                         self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
+                if (self.path == "/debug/soak"
+                        or self.path.startswith("/debug/soak?")):
+                    try:
+                        self._reply(200, server.handle_debug_soak())
+                    except Exception as e:
+                        self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
                 if (self.path == "/api/timeseries"
                         or self.path.startswith("/api/timeseries?")):
                     try:
@@ -468,6 +475,21 @@ class NodeWebServer:
             return {"groups": {}}
         return report_fn()
 
+    def handle_debug_soak(self) -> dict:
+        """GET /debug/soak — the soak observatory's live view: every
+        structure registered with the resource accounting plane (size,
+        declared kind, leak verdict over its retained ``Resource.*``
+        series) plus the subsystem CPU-attribution snapshot when a
+        profiler is running (observability/soak.py). Served from the ops
+        object when it exposes ``soak_report``, straight off the process
+        globals otherwise; well-formed and empty on a node with no
+        registered probes — scraping any node is safe."""
+        report_fn = getattr(self.ops, "soak_report", None)
+        if report_fn is not None:
+            return report_fn()
+        from ..observability.soak import soak_report
+        return soak_report()
+
     def handle_api_timeseries(self, path: str) -> dict:
         """GET /api/timeseries — the retained time-series plane:
         downsampled multi-resolution history of the consensus gauges
@@ -485,11 +507,27 @@ class NodeWebServer:
         limit = int(limit_raw) if limit_raw is not None else None
         if limit is not None and limit < 1:
             raise ValueError(f"limit must be >= 1, got {limit}")
+        # incremental-poll filters (soak observatory): ``since`` drops
+        # buckets starting before that absolute epoch time, ``resolution``
+        # keeps only the ring with that bucket width (e.g. 60 for the
+        # coarse leak-fit ring)
+        since_raw = q.get("since", [None])[0]
+        since = float(since_raw) if since_raw is not None else None
+        res_raw = q.get("resolution", [None])[0]
+        resolution = float(res_raw) if res_raw is not None else None
+        if resolution is not None and resolution <= 0:
+            raise ValueError(f"resolution must be > 0, got {resolution}")
         snap_fn = getattr(self.ops, "timeseries_snapshot", None)
         if snap_fn is not None:
-            return snap_fn(names, limit)
+            try:
+                return snap_fn(names, limit, since, resolution)
+            except TypeError:
+                # ops surface predating the soak filters: serve unfiltered
+                # rather than 500 — the poller just gets more data
+                return snap_fn(names, limit)
         from ..observability import get_timeseries
-        return get_timeseries().snapshot(names=names, limit=limit)
+        return get_timeseries().snapshot(names=names, limit=limit,
+                                         since=since, resolution=resolution)
 
     def handle_traces(self, path: str) -> tuple[str, bytes]:
         """GET /traces — spans from the live tracer's ring buffer.

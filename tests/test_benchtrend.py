@@ -63,6 +63,37 @@ def test_ledger_family_carries_critpath_columns():
     assert "-" in old.split() and "scheduler.wait" in new
 
 
+def test_soak_family_tolerates_pre_soak_artifacts(tmp_path):
+    metrics = FAMILIES["soak"][1]
+    assert metrics[0] == "committed_tx_per_sec"
+    for col in ("soak_leak_ok", "soak_drift_ok",
+                "soak_cpu_top_commit_path", "soak_chaos_cycles"):
+        assert col in metrics
+    # a pre-soak LEDGER-shaped artifact mixed into the table renders "-"
+    # in every soak column; a soak round fills them — side by side
+    rounds = [
+        ("r01", {"committed_tx_per_sec": 5.1}),
+        ("r02", {"committed_tx_per_sec": 5.3, "soak_minutes": 10.0,
+                 "soak_throughput_slope_pct_per_min": -0.4,
+                 "soak_p99_slope_pct_per_min": 1.2, "soak_drift_ok": True,
+                 "soak_leak_ok": True, "soak_invariant_ok": True,
+                 "soak_cpu_top_commit_path": "batcher_prep",
+                 "soak_cpu_share_sum_pct": 100.0, "soak_chaos_cycles": 7}),
+    ]
+    out = render_table("soak", rounds, metrics)
+    old = next(l for l in out.splitlines() if l.startswith("r01"))
+    new = next(l for l in out.splitlines() if l.startswith("r02"))
+    assert old.split().count("-") >= 9
+    assert "batcher_prep" in new and "yes" in new
+    # the soak glob finds SOAK_r*.json artifacts only
+    (tmp_path / "SOAK_r01.json").write_text(json.dumps(
+        {"committed_tx_per_sec": 5.0}))
+    (tmp_path / "LEDGER_r01.json").write_text(json.dumps({}))
+    loaded = load_rounds("soak", root=str(tmp_path))
+    assert [r[0] for r in loaded] == ["r01"]
+    assert loaded[0][1]["committed_tx_per_sec"] == 5.0
+
+
 def test_load_rounds_orders_and_unwraps(tmp_path):
     # BENCH artifacts wrap the metrics in "parsed"; LEDGER ones are flat
     (tmp_path / "BENCH_r02.json").write_text(json.dumps(

@@ -310,6 +310,44 @@ def test_sample_timeseries_records_and_flushes():
         "flush must seal every resolution"
 
 
+def test_sample_timeseries_with_resource_registry():
+    """Satellite (ISSUE 19): the sampling tick takes the resource
+    accounting plane — every registered structure lands as a
+    ``Resource.*`` series and rides the SAME growth watchdog (doubling
+    warnings for free), while the two historical hazards keep their
+    exact jlog series names."""
+    from corda_tpu.observability.resprof import ResourceRegistry
+
+    _, nodes, leader = committed_cluster(n_commits=2)
+    reg = ResourceRegistry()
+    size = {"v": 200.0}
+    reg.register("Some.Pool", lambda: size["v"], kind="bounded")
+    store = TimeSeriesStore(resolutions=((0.5, 16),))
+    watch = GrowthWatch(floor=1.0)
+    values = sample_timeseries(store, {"s0": nodes}, watch=watch, t=100.0,
+                               resources=reg)
+    # byte-compat: the historical hazard series names are unchanged
+    assert 'Raft.LogEntries{group="s0"}' in values
+    assert values["Resource.Some.Pool"] == 200.0
+    size["v"] = 500.0                             # ≥ 2× the armed baseline
+    before = watch.warnings
+    sample_timeseries(store, {"s0": nodes}, watch=watch, t=101.0,
+                      resources=reg)
+    assert watch.warnings == before + 1
+    store.flush()
+    assert "Resource.Some.Pool" in store.snapshot()["series"]
+
+    # a registry whose sample() blows up loses only the Resource.* rows,
+    # never the consensus gauges
+    class Broken:
+        def sample(self, **kw):
+            raise RuntimeError("boom")
+
+    values = sample_timeseries(store, {"s0": nodes}, t=102.0,
+                               resources=Broken())
+    assert 'Raft.LogEntries{group="s0"}' in values
+
+
 def test_skew_index():
     assert skew_index([]) == 0.0
     assert skew_index([0, 0]) == 0.0

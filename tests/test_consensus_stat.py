@@ -80,3 +80,45 @@ def test_render_survives_empty_and_malformed():
     broken_ts = {"series": {"x": [{"points": [[1], "junk", None]},
                                   "garbage"]}}
     assert render(RAFT, broken_ts)
+
+
+SOAK = {
+    "resources": {
+        "Vault.States": {"size": 120, "kind": "grows",
+                         "verdict": "growing", "slope_per_s": 1.4},
+        "Staging.Buffers": {"size": 8, "kind": "bounded",
+                            "verdict": "bounded", "slope_per_s": 0.0},
+        "Requests.Timelines": {"size": 512, "kind": "bounded",
+                               "verdict": "leaking", "slope_per_s": 2.5},
+    },
+    "leaking": ["Requests.Timelines"],
+    "cpu": {"shares_pct": {"raft_pump": 40.0, "serialization": 35.0,
+                           "other": 25.0, "network": 0.0},
+            "share_sum_pct": 100.0, "top_commit_path": "raft_pump"},
+}
+
+
+def test_render_soak_section():
+    screen = render(RAFT, TIMESERIES, SOAK)
+    assert "soak resources" in screen
+    vault = next(l for l in screen.splitlines() if "Vault.States" in l)
+    assert "grows" in vault and "growing" in vault and "+1.4/s" in vault
+    # a leaking verdict is flagged loudly
+    leak = next(l for l in screen.splitlines()
+                if "Requests.Timelines" in l)
+    assert "leaking" in leak and "!!" in leak
+    # CPU shares render busiest-first with the commit-path headline
+    cpu = next(l for l in screen.splitlines() if l.startswith("cpu shares"))
+    assert "top commit-path: raft_pump" in cpu
+    assert cpu.index("raft_pump=40.0%") < cpu.index("serialization=35.0%")
+    assert "network=0.0%" not in cpu       # zero shares are noise
+
+
+def test_render_soak_section_survives_garbage():
+    base = render(RAFT, TIMESERIES)
+    # absent / malformed payloads lose the section, never the screen
+    for junk in (None, "oops", 42, {"resources": "x"},
+                 {"resources": {"a": "junk", "b": {"verdict": None}},
+                  "cpu": {"shares_pct": "x"}}):
+        assert "consensus groups" in render(RAFT, TIMESERIES, junk)
+    assert render(RAFT, TIMESERIES, None) == base

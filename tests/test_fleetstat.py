@@ -178,3 +178,31 @@ def test_render_controller_block_survives_garbage():
                              "recent_actions": [None, "bad", {}]}):
         screen = render(dict(FLEET, controller=ctl), METRICS)
         assert "w0" in screen      # worker table still renders
+
+
+def test_render_soak_line():
+    soak = {
+        "resources": {
+            "Vault.States": {"size": 120, "kind": "grows",
+                             "verdict": "growing"},
+            "Staging.Buffers": {"size": 8, "kind": "bounded",
+                                "verdict": "bounded"},
+            "Requests.Timelines": {"size": 512, "kind": "bounded",
+                                   "verdict": "leaking"},
+        },
+        "leaking": ["Requests.Timelines"],
+        "cpu": {"shares_pct": {"raft_pump": 60.0},
+                "top_commit_path": "raft_pump"},
+    }
+    screen = render(FLEET, METRICS, soak=soak)
+    line = next(l for l in screen.splitlines() if l.startswith("soak:"))
+    assert "3 structures" in line
+    assert "leaking=1['Requests.Timelines']" in line
+    assert "growing=1" in line
+    assert "cpu_top=raft_pump" in line
+    # no soak plane (old node): line simply absent
+    assert "soak:" not in render(FLEET, METRICS)
+    # malformed payloads lose the line, never the screen
+    for junk in ("oops", 42, {"resources": "x"},
+                 {"resources": {"a": None}, "leaking": 7, "cpu": "x"}):
+        assert "w0" in render(FLEET, METRICS, soak=junk)

@@ -1,0 +1,267 @@
+"""Soak observatory (observability/soak.py): the tier-1 accelerated
+smoke soak asserts the SAME artifact schema as a full endurance run —
+phases, per-structure leak verdicts, CPU attribution, drift slopes,
+mid-run invariant re-checks — without the multi-minute wall clock, plus
+pure-function tests for the drift fit, ring selection and the
+guard_soak gate."""
+import copy
+
+import pytest
+
+import corda_tpu.finance  # noqa: F401  (contract registration)
+from corda_tpu.observability.resprof import ResourceRegistry, set_resources
+from corda_tpu.observability.soak import (SoakConfig, run_soak, soak_report,
+                                          soak_drift_fields, verdict_rows)
+from corda_tpu.observability.timeseries import TimeSeriesStore, set_timeseries
+from corda_tpu.tools.benchguard import SOAK_REQUIRED, guard_soak
+
+pytestmark = [pytest.mark.soak, pytest.mark.ledger]
+
+
+@pytest.fixture(scope="module")
+def smoke_report():
+    """One accelerated soak for the whole module (~20 s of real load:
+    5 s phases, 6 s chaos period, 4 s invariant cadence)."""
+    return run_soak(SoakConfig.smoke(seed=7))
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 smoke soak: full schema, green gate
+# ---------------------------------------------------------------------------
+
+def test_smoke_soak_carries_full_schema(smoke_report):
+    for field in SOAK_REQUIRED:
+        assert field in smoke_report, field
+    assert smoke_report["mode"] == "soak-smoke"
+
+
+def test_smoke_soak_passes_guard(smoke_report):
+    assert guard_soak(smoke_report, trajectory_paths=[]) == []
+
+
+def test_smoke_soak_invariants_and_phases(smoke_report):
+    r = smoke_report
+    assert r["exactly_once_ok"] and r["replicas_agree"]
+    assert r["soak_leak_ok"] and r["soak_leaking"] == []
+    assert r["soak_invariant_ok"]
+    assert r["soak_invariant_recheck_count"] >= 2
+    for check in r["soak_invariant_checks"]:
+        assert check["ok"] and check["conflicts"] == 0
+        assert check["checked"] >= 0
+    assert len(r["soak_phases"]) >= 2
+    # the phase ledger accounts for the committed work (a sub-0.5 s tail
+    # after the last sealed phase may legitimately fall outside it)
+    assert 0 < sum(p["committed"] for p in r["soak_phases"]) \
+        <= r["committed_tx_count"]
+    for p in r["soak_phases"]:
+        assert p["duration_s"] > 0
+        assert p["committed_tx_per_sec"] >= 0
+
+
+def test_smoke_soak_chaos_recurred(smoke_report):
+    r = smoke_report
+    assert r["soak_chaos_cycles"] >= 1
+    for w in r["soak_chaos_windows"]:
+        assert w["kind"] in ("partition_follower", "leader_kill",
+                             "append_drop")
+        assert w["end_s"] >= w["start_s"]
+
+
+def test_smoke_soak_resource_verdicts(smoke_report):
+    """Every registered structure carries a leak verdict; the topology's
+    core hazards are all registered."""
+    verdicts = smoke_report["soak_leak_verdicts"]
+    for expected in ("CoordinatorLog.Bytes", "Tracing.SpanRing",
+                     "Tracing.SpansDropped", "Vault.States",
+                     "Checkpoints.Stored", "Shard.ReservedRefs",
+                     "Process.RSSBytes", "Timeseries.Buckets"):
+        assert expected in verdicts, expected
+    assert any(n.startswith("RaftLog.") for n in verdicts)
+    for name, v in verdicts.items():
+        assert v["verdict"] in ("bounded", "growing"), name
+        assert "slope_per_s" in v and "points" in v
+    assert set(smoke_report["soak_resources"]) == set(verdicts)
+    # churn accounting (satellite): the windowed drop/eviction rates are
+    # numbers, not cumulative-only counters
+    assert smoke_report["soak_spans_dropped_rate_per_s"] >= 0.0
+    assert smoke_report["soak_timeline_evictions_rate_per_s"] >= 0.0
+
+
+def test_smoke_soak_cpu_attribution(smoke_report):
+    r = smoke_report
+    assert r["soak_cpu_samples"] >= 1
+    shares = r["soak_cpu_shares_pct"]
+    assert r["soak_cpu_share_sum_pct"] == pytest.approx(100.0, abs=0.5)
+    assert sum(shares.values()) == pytest.approx(100.0, abs=0.5)
+    assert r["soak_cpu_top_commit_path"] in shares
+    assert 0.0 < r["soak_cpu_busy_frac"] <= 1.0
+
+
+def test_smoke_soak_drift_fields_recorded(smoke_report):
+    """Smoke records the drift slopes (the fit runs) but the gate does
+    not enforce them — a 20 s window is too noisy for slope floors."""
+    r = smoke_report
+    for f in ("soak_throughput_slope_pct_per_min",
+              "soak_p99_slope_pct_per_min"):
+        assert isinstance(r[f], (int, float))
+    assert r["soak_throughput_gate_pct_per_min"] == -3.0
+    assert r["soak_p99_gate_pct_per_min"] == 6.0
+
+
+# ---------------------------------------------------------------------------
+# guard_soak on doctored artifacts
+# ---------------------------------------------------------------------------
+
+def _full(report):
+    """A doctored copy that reads as a FULL (non-smoke) run."""
+    r = copy.deepcopy(report)
+    r["mode"] = "soak"
+    r.pop("smoke", None)
+    return r
+
+
+def test_guard_flags_missing_and_mistyped_fields(smoke_report):
+    r = copy.deepcopy(smoke_report)
+    del r["soak_phases"]
+    assert any("missing required soak field 'soak_phases'" in p
+               for p in guard_soak(r, trajectory_paths=[]))
+    r = copy.deepcopy(smoke_report)
+    r["soak_leak_verdicts"] = "nope"
+    assert any("soak_leak_verdicts" in p
+               for p in guard_soak(r, trajectory_paths=[]))
+
+
+def test_guard_flags_leaking_structure(smoke_report):
+    r = copy.deepcopy(smoke_report)
+    r["soak_leaking"] = ["Staging.Buffers"]
+    r["soak_leak_ok"] = False
+    problems = guard_soak(r, trajectory_paths=[])
+    assert any("Staging.Buffers" in p for p in problems)
+
+
+def test_guard_flags_failed_invariant_recheck(smoke_report):
+    r = copy.deepcopy(smoke_report)
+    r["soak_invariant_ok"] = False
+    assert any("invariant re-check failed" in p
+               for p in guard_soak(r, trajectory_paths=[]))
+    r = copy.deepcopy(smoke_report)
+    r["soak_invariant_checks"] = []
+    r["soak_invariant_recheck_count"] = 0
+    assert any("no mid-run invariant re-check" in p
+               for p in guard_soak(r, trajectory_paths=[]))
+
+
+def test_guard_flags_malformed_verdicts_and_no_chaos(smoke_report):
+    r = copy.deepcopy(smoke_report)
+    r["soak_leak_verdicts"] = {"X": {"verdict": "maybe"}}
+    assert any("well-formed leak verdict" in p
+               for p in guard_soak(r, trajectory_paths=[]))
+    r = copy.deepcopy(smoke_report)
+    r["soak_chaos_cycles"] = 0
+    assert any("no recurring chaos cycle" in p
+               for p in guard_soak(r, trajectory_paths=[]))
+
+
+def test_guard_full_run_enforces_cpu_band_and_drift(smoke_report):
+    # the same numbers pass as smoke but a FULL run enforces the CPU
+    # sanity band and the self-declared drift gates
+    r = _full(smoke_report)
+    r["soak_cpu_share_sum_pct"] = 55.0
+    assert any("90–110%" in p for p in guard_soak(r, trajectory_paths=[]))
+    r = _full(smoke_report)
+    r["soak_drift_ok"] = False
+    assert any("drift gate breached" in p
+               for p in guard_soak(r, trajectory_paths=[]))
+    r = _full(smoke_report)
+    r["soak_cpu_top_commit_path"] = ""
+    assert any("top commit-path" in p
+               for p in guard_soak(r, trajectory_paths=[]))
+
+
+# ---------------------------------------------------------------------------
+# drift fit + ring selection (pure functions)
+# ---------------------------------------------------------------------------
+
+def _phases(rates, p99s, phase_s=60.0):
+    return [{"t_s": i * phase_s, "committed_tx_per_sec": r,
+             "e2e_ms_p99": p} for i, (r, p) in enumerate(zip(rates, p99s))]
+
+
+def test_drift_fields_stable_run_passes():
+    out = soak_drift_fields(_phases([6.0] * 8, [40.0] * 8), -3.0, 6.0)
+    assert out["soak_drift_ok"] is True
+    assert out["soak_throughput_slope_pct_per_min"] == pytest.approx(0.0)
+    assert out["soak_p99_slope_pct_per_min"] == pytest.approx(0.0)
+
+
+def test_drift_fields_degrading_throughput_breaches_gate():
+    # committed rate sagging ~5%/min against a -3%/min floor
+    rates = [6.0 - 0.3 * i for i in range(8)]
+    out = soak_drift_fields(_phases(rates, [40.0] * 8), -3.0, 6.0)
+    assert out["soak_throughput_slope_pct_per_min"] < -3.0
+    assert out["soak_drift_ok"] is False
+
+
+def test_drift_fields_rising_p99_breaches_gate():
+    p99s = [40.0 * (1.0 + 0.15 * i) for i in range(8)]
+    out = soak_drift_fields(_phases([6.0] * 8, p99s), -3.0, 6.0)
+    assert out["soak_p99_slope_pct_per_min"] > 6.0
+    assert out["soak_drift_ok"] is False
+
+
+def test_drift_fields_too_few_phases_is_zero_drift():
+    out = soak_drift_fields(_phases([6.0, 1.0], [40.0, 900.0]), -3.0, 6.0)
+    assert out["soak_throughput_slope_pct_per_min"] == 0.0
+    assert out["soak_drift_ok"] is True
+    # zero-latency phases (nothing committed) drop out of the p99 fit
+    out = soak_drift_fields(_phases([6.0] * 5, [0.0] * 5), -3.0, 6.0)
+    assert out["soak_p99_slope_pct_per_min"] == 0.0
+
+
+def test_verdict_rows_prefers_coarsest_populated_ring():
+    fine = [[float(t), 1, 0, 0, float(t), 0] for t in range(100)]
+    coarse = [[60.0 * t, 10, 0, 0, float(t), 0] for t in range(8)]
+    rings = [{"bucket_s": 0.5, "points": fine},
+             {"bucket_s": 60.0, "points": coarse}]
+    assert verdict_rows(rings) == coarse       # coarsest with ≥5 points
+    # a smoke run never fills the 60 s ring: fall back to the fine one
+    rings = [{"bucket_s": 0.5, "points": fine},
+             {"bucket_s": 60.0, "points": coarse[:2]}]
+    assert verdict_rows(rings) == fine
+    assert verdict_rows([]) == []
+    assert verdict_rows([{"bucket_s": 0.5}, "junk", None]) == []
+
+
+# ---------------------------------------------------------------------------
+# the live /debug/soak payload
+# ---------------------------------------------------------------------------
+
+def test_soak_report_composes_live_registry_and_retained_series():
+    reg = ResourceRegistry()
+    size = {"v": 5.0}
+    reg.register("Live.Thing", lambda: size["v"], kind="bounded")
+    store = TimeSeriesStore(resolutions=((1.0, 16),))
+    prev_reg, prev_store = set_resources(reg), set_timeseries(store)
+    try:
+        for t in range(10):
+            reg.sample(store=store, t=float(t))
+        store.flush()
+        out = soak_report()
+        assert list(out["resources"]) == ["Live.Thing"]
+        r = out["resources"]["Live.Thing"]
+        assert r["size"] == 5.0 and r["kind"] == "bounded"
+        assert r["verdict"] == "bounded"
+        assert out["leaking"] == []
+        assert out["cpu"] is None              # no profiler running
+    finally:
+        set_resources(prev_reg)
+        set_timeseries(prev_store)
+
+
+def test_soak_report_empty_node_is_well_formed():
+    prev_reg = set_resources(ResourceRegistry())
+    try:
+        out = soak_report()
+        assert out == {"resources": {}, "leaking": [], "cpu": None}
+    finally:
+        set_resources(prev_reg)

@@ -84,6 +84,75 @@ def test_store_bounds_series_count():
     store.record("s0", 2.0, t=1.0)
 
 
+def test_cascade_under_long_horizon_clock():
+    """Hours of injected clock across every sealing boundary of the soak
+    resolutions (0.5 s → 5 s → 60 s): the finest rings wrap many times
+    over but the coarsest still accounts for every sample inside its
+    horizon — the property the soak leak fit stands on."""
+    ts = TimeSeries(resolutions=((0.5, 240), (5.0, 240), (60.0, 240)))
+    # one sample per second for 3 injected hours (no real sleeping)
+    n = 3 * 3600
+    for i in range(n):
+        ts.record(float(i), float(i % 7))
+    ts.flush()
+    fine, mid, coarse = ts.snapshot()
+    assert len(fine["points"]) <= 240 and len(mid["points"]) <= 240
+    # the 60 s ring holds the newest 240 minutes — 3 h fits entirely
+    assert len(coarse["points"]) == n // 60
+    assert sum(p[1] for p in coarse["points"]) == n
+    # buckets stay time-ordered after hours of cascade churn
+    starts = [p[0] for p in coarse["points"]]
+    assert starts == sorted(starts)
+
+
+def test_snapshot_since_filter_straddling_a_seal():
+    """``since`` is the incremental-poller contract: only buckets
+    starting at/after the cutoff return, and a bucket that was OPEN at
+    the cutoff reappears (sealed) in the next poll — at-least-once,
+    never silently dropped."""
+    ts = TimeSeries(resolutions=((1.0, 8), (10.0, 8)))
+    for i in range(6):
+        ts.record(float(i), float(i))
+    # the t=5 bucket is still open; a poller that saw through t=4 asks
+    # with since=5 and gets the open bucket's current aggregate
+    snap = ts.snapshot(since=5.0)
+    fine = snap[0]["points"]
+    assert [p[0] for p in fine] == [5.0]
+    # more samples land in that same bucket after the poll, then it
+    # seals: polling with the SAME cutoff re-delivers it, now final
+    ts.record(5.5, 100.0)
+    ts.record(6.0, 1.0)          # opens t=6, sealing the t=5 bucket
+    fine = ts.snapshot(since=5.0)[0]["points"]
+    assert [p[0] for p in fine] == [5.0, 6.0]
+    assert fine[0][1] == 2 and fine[0][3] == 100.0   # n, max — resealed
+    # a cutoff beyond everything is an empty (not missing) resolution
+    assert ts.snapshot(since=1e9)[0]["points"] == []
+
+
+def test_snapshot_resolution_filter():
+    ts = TimeSeries(resolutions=((0.5, 8), (5.0, 8), (60.0, 8)))
+    for i in range(20):
+        ts.record(float(i), 1.0)
+    ts.flush()
+    only = ts.snapshot(resolution=5.0)
+    assert len(only) == 1 and only[0]["bucket_s"] == 5.0
+    assert only[0]["points"]
+    # an unknown resolution matches nothing — empty list, not an error
+    assert ts.snapshot(resolution=7.0) == []
+
+
+def test_store_snapshot_since_and_resolution_passthrough():
+    store = TimeSeriesStore(resolutions=((1.0, 8), (10.0, 8)))
+    for i in range(12):
+        store.record("Resource.X", float(i), t=float(i))
+    store.flush()
+    snap = store.snapshot(names=["Resource.X"], since=8.0, resolution=1.0)
+    levels = snap["series"]["Resource.X"]
+    assert len(levels) == 1 and levels[0]["bucket_s"] == 1.0
+    assert all(p[0] >= 8.0 for p in levels[0]["points"])
+    assert snap["columns"] == list(COLUMNS)
+
+
 def test_global_store_seam():
     mine = TimeSeriesStore()
     prev = set_timeseries(mine)

@@ -253,6 +253,91 @@ def test_api_timeseries_routes_and_validation():
         set_timeseries(prev)
 
 
+def test_api_timeseries_since_and_resolution_filters():
+    from corda_tpu.observability.timeseries import TimeSeriesStore
+
+    class Ops:
+        def __init__(self, store):
+            self.store = store
+
+        def timeseries_snapshot(self, names=None, limit=None, since=None,
+                                resolution=None):
+            return self.store.snapshot(names=names, limit=limit,
+                                       since=since, resolution=resolution)
+
+    store = TimeSeriesStore(resolutions=((1.0, 8), (10.0, 8)))
+    for i in range(12):
+        store.record("Resource.Vault.States", float(i), t=float(i))
+    store.flush()
+    server = NodeWebServer(Ops(store)).start()
+    try:
+        # an incremental poller asks only for buckets it has not seen
+        out = _get(server, "/api/timeseries?since=8")
+        pts = out["series"]["Resource.Vault.States"][0]["points"]
+        assert pts and all(p[0] >= 8.0 for p in pts)
+        # the soak leak fit asks for one ring by its bucket width
+        out = _get(server, "/api/timeseries?resolution=10")
+        levels = out["series"]["Resource.Vault.States"]
+        assert len(levels) == 1 and levels[0]["bucket_s"] == 10.0
+        # unknown resolution matches nothing — empty, never an error
+        out = _get(server, "/api/timeseries?resolution=7")
+        assert out["series"]["Resource.Vault.States"] == []
+        # malformed filters are the client's fault
+        for bad in ("/api/timeseries?resolution=0",
+                    "/api/timeseries?resolution=zap",
+                    "/api/timeseries?since=zap"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(server, bad)
+            assert ei.value.code == 400
+    finally:
+        server.stop()
+
+    # an ops surface predating the soak filters (2-arg snapshot) serves
+    # the unfiltered snapshot rather than a 500
+    class OldOps:
+        def __init__(self, store):
+            self.store = store
+
+        def timeseries_snapshot(self, names=None, limit=None):
+            return self.store.snapshot(names=names, limit=limit)
+
+    old = NodeWebServer(OldOps(store)).start()
+    try:
+        out = _get(old, "/api/timeseries?since=8&resolution=10")
+        assert "Resource.Vault.States" in out["series"]
+    finally:
+        old.stop()
+
+
+def test_debug_soak_serves_ops_report_and_global_seam():
+    from corda_tpu.observability.resprof import (ResourceRegistry,
+                                                 set_resources)
+
+    class Ops:
+        def soak_report(self):
+            return {"resources": {"X": {"size": 1, "kind": "bounded",
+                                        "verdict": "bounded"}},
+                    "leaking": [], "cpu": None}
+
+    server = NodeWebServer(Ops()).start()
+    try:
+        out = _get(server, "/debug/soak")
+        assert out["resources"]["X"]["verdict"] == "bounded"
+        assert out["leaking"] == []
+    finally:
+        server.stop()
+    # an ops surface without the capability reads the process globals —
+    # well-formed and empty on a node with no registered probes
+    prev = set_resources(ResourceRegistry())
+    bare = NodeWebServer(object()).start()
+    try:
+        out = _get(bare, "/debug/soak")
+        assert out == {"resources": {}, "leaking": [], "cpu": None}
+    finally:
+        bare.stop()
+        set_resources(prev)
+
+
 def test_debug_requests_serves_request_log():
     from corda_tpu.observability import RequestLog
 
