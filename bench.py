@@ -511,9 +511,13 @@ def ledger_main() -> None:
     top_shards = max(shard_counts)
     if SMOKE:
         # 2-shard CPU shape: tier-1 exercises the sharded provider +
-        # cross-shard 2PC on every run (ISSUE 15 satellite)
+        # cross-shard 2PC on every run (ISSUE 15 satellite). Small
+        # compaction thresholds so every smoke run also proves the
+        # bounded-log sawtooth and CoordinatorLog GC (ISSUE 20).
         cfg = LedgerScenarioConfig(shards=min(2, top_shards),
-                                   cross_shard_pct=0.25)
+                                   cross_shard_pct=0.25,
+                                   raft_snapshot_entries=4,
+                                   coordlog_compact_bytes=1024)
     else:
         # The full flows scenario stays UNSHARDED: its fields carry
         # best-so-far floors fitted from the r01..r03 single-group
@@ -624,6 +628,30 @@ def ledger_main() -> None:
                         "(want >= 2)")
     if out.get("shard_sweep_skew_index", 0.0) <= 0.0:
         problems.append("shard sweep reported no skew index")
+    # bounded-state consensus (ISSUE 20): with compaction armed, replicas
+    # must actually have snapshotted, and the RETAINED log must sawtooth
+    # strictly under 2× the threshold — a peak at/over that bound means
+    # compaction is not keeping up and the log is unbounded in disguise.
+    snap_thr = out.get("ledger_raft_snapshot_threshold", 0)
+    if snap_thr > 0:
+        if out.get("ledger_raft_snapshots_taken", 0) < 1:
+            problems.append("compaction armed "
+                            f"(threshold {snap_thr}) but no replica took "
+                            "a snapshot")
+        log_peak = out.get("ledger_raft_log_entries_peak", 0)
+        if log_peak >= 2 * snap_thr:
+            problems.append(f"retained raft log peaked at {log_peak} "
+                            f"entries against a {snap_thr}-entry snapshot "
+                            "threshold (bounded-sawtooth invariant broken)")
+        # the full chaos shape must additionally show the recovery paths
+        # the smoke run is too small to force deterministically
+        if not SMOKE and cfg.chaos:
+            if out.get("ledger_raft_installs_received", 0) < 1:
+                problems.append("chaos run with compaction: no lagging "
+                                "follower caught up via InstallSnapshot")
+            if out.get("ledger_raft_restarts", 0) < 1:
+                problems.append("chaos run with compaction: no replica "
+                                "crash-restart was executed")
     if problems:
         for p in problems:
             print(f"BENCH INVALID: {p}", file=sys.stderr)

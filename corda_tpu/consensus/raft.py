@@ -9,8 +9,17 @@ manually — no wall-clock in the protocol core) and the TCP plane (a timer
 thread calls `tick()`).
 
 Simplifications vs full Raft (documented, safe for the notary use case):
-snapshots/compaction and membership changes are not implemented; logs are
-kept in memory with the application results re-derivable by replay.
+membership changes are not implemented. Snapshot-based log compaction IS
+implemented (ISSUE 20): when constructed with a ``snapshot_fn/restore_fn``
+seam and an entry-count threshold, a replica periodically serializes the
+applied state machine at ``last_applied``, persists it as a snapshot
+record, and truncates the log prefix — the log then starts at
+``snapshot_index + 1`` and every consistency check anchors prev_index /
+prev_term at the snapshot. A leader that needs compacted-away entries to
+catch a lagging follower ships a single-frame InstallSnapshot (our frames
+are in-process/TCP — no chunking needed); a restarting replica loads
+snapshot + log suffix instead of replaying from genesis. Without the seam
+(bare protocol tests) logs stay unbounded, exactly as before.
 """
 from __future__ import annotations
 
@@ -82,6 +91,22 @@ class AppendResponse:
 
 
 @dataclass(frozen=True)
+class InstallSnapshot:
+    """Leader → lagging follower: the serialized state machine at
+    ``last_index`` (Raft §7). Single frame — the transport is in-process
+    or one TCP connection, so the reference's chunked offset/done protocol
+    collapses to one message. The follower restores the state machine,
+    discards its log, and acks with a normal AppendResponse at
+    ``last_index`` so the leader's match/next bookkeeping needs no new
+    message type."""
+    term: int
+    leader: str
+    last_index: int
+    last_term: int
+    data: bytes
+
+
+@dataclass(frozen=True)
 class ClientRequest:
     request_id: int
     client: str
@@ -108,7 +133,8 @@ class ClientResponse:
 
 
 for _cls in (LogEntry, RequestVote, VoteResponse, AppendEntries,
-             AppendResponse, ClientRequest, ClientResponse):
+             AppendResponse, InstallSnapshot, ClientRequest,
+             ClientResponse):
     register_type(f"raft.{_cls.__name__}", _cls)
 
 
@@ -121,14 +147,25 @@ class RaftState:
         self.log: list[LogEntry] = []      # 1-based indexing via helpers
         self.commit_index = 0
         self.last_applied = 0
+        # the log base after compaction: ``log[0]`` holds absolute index
+        # ``snapshot_index + 1``; ``term_at(snapshot_index)`` answers
+        # ``snapshot_term`` so AppendEntries consistency checks anchored
+        # exactly at the snapshot still pass (Raft §7)
+        self.snapshot_index = 0
+        self.snapshot_term = 0
 
     def last_index(self) -> int:
-        return len(self.log)
+        return self.snapshot_index + len(self.log)
 
     def term_at(self, index: int) -> int:
+        if index == self.snapshot_index:
+            return self.snapshot_term
         if index == 0:
             return 0
-        return self.log[index - 1].term
+        return self.log[index - self.snapshot_index - 1].term
+
+    def entry_at(self, index: int) -> LogEntry:
+        return self.log[index - self.snapshot_index - 1]
 
 
 class RaftNode:
@@ -138,21 +175,60 @@ class RaftNode:
 
     def __init__(self, node_id: str, peers: list[str], messaging,
                  apply_fn: Callable[[Any], Any], seed: int | None = None,
-                 storage=None):
+                 storage=None, snapshot_fn: Callable[[], bytes] | None = None,
+                 restore_fn: Callable[[bytes], None] | None = None,
+                 snapshot_entries: int | None = None):
         """``storage``: an optional consensus.raft_store.RaftLogStore making
-        the replica's persistent state (term, vote, log) survive restarts —
-        Raft §5.1; the Copycat durable-storage role."""
+        the replica's persistent state (term, vote, log, snapshot) survive
+        restarts — Raft §5.1; the Copycat durable-storage role.
+
+        ``snapshot_fn() -> bytes`` / ``restore_fn(blob)``: the state-machine
+        snapshot seam (DistributedImmutableMap.snapshot/restore). When BOTH
+        ``snapshot_fn`` and ``snapshot_entries`` are given, the replica
+        compacts its log every time ``last_applied - snapshot_index``
+        reaches the threshold; ``restore_fn`` additionally lets the replica
+        accept InstallSnapshot and resume from a stored snapshot at
+        restart. Leave them unset for the unbounded pre-compaction
+        behavior."""
         self.node_id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.messaging = messaging
         self.apply_fn = apply_fn
         self.storage = storage
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.snapshot_entries = snapshot_entries
         self.state = RaftState()
+        # compaction bookkeeping (under _lock): the latest snapshot blob is
+        # retained in memory so InstallSnapshot needs no storage round-trip
+        self._snapshot_blob: bytes | None = None
+        self._snapshot_bytes = 0
+        self._snapshots_taken = 0
+        self._installs_sent = 0
+        self._installs_received = 0
         if storage is not None:
-            term, vote, entries = storage.load()
+            if hasattr(storage, "load_state"):
+                (term, vote, snap_index, snap_term, blob,
+                 entries) = storage.load_state()
+            else:   # pre-snapshot store shim
+                term, vote, entries = storage.load()
+                snap_index, snap_term, blob = 0, 0, None
             self.state.current_term = term
             self.state.voted_for = vote
             self.state.log = entries
+            if snap_index > 0 and blob is not None:
+                # crash-restart recovery: resume from snapshot + suffix
+                # instead of replaying from genesis. commit_index/last_applied
+                # start at the snapshot; the leader's heartbeats re-advance
+                # them over the suffix (commit index is volatile in Raft).
+                self.state.snapshot_index = snap_index
+                self.state.snapshot_term = snap_term
+                self.state.commit_index = snap_index
+                self.state.last_applied = snap_index
+                self._snapshot_blob = blob
+                self._snapshot_bytes = len(blob)
+                if restore_fn is not None:
+                    restore_fn(blob)
         self.role = FOLLOWER
         self.leader_id: str | None = None
         self._rng = random.Random(seed if seed is not None else node_id)
@@ -228,14 +304,14 @@ class RaftNode:
         """Persist the entry just appended in memory."""
         if self.storage is not None:
             idx = self.state.last_index()
-            self.storage.append(idx, self.state.log[idx - 1])
+            self.storage.append(idx, self.state.entry_at(idx))
 
     def _persist_suffix(self, from_index: int) -> None:
         """Persist a conflict overwrite: truncate + rewrite from_index on."""
         if self.storage is not None:
             self.storage.truncate_from(from_index)
             for idx in range(from_index, self.state.last_index() + 1):
-                self.storage.append(idx, self.state.log[idx - 1])
+                self.storage.append(idx, self.state.entry_at(idx))
 
     # -- elections -----------------------------------------------------------
     def _start_election(self) -> None:
@@ -319,15 +395,43 @@ class RaftNode:
 
     def _send_append(self, peer: str) -> None:
         from ..utils.faults import DROP, fault_point
+        next_i = self._next_index.get(peer, self.state.last_index() + 1)
+        if next_i <= self.state.snapshot_index:
+            # the entries this follower needs were compacted away: ship the
+            # snapshot instead (Raft §7) — the follower's AppendResponse at
+            # snapshot last_index resumes normal replication from there
+            self._send_snapshot(peer)
+            return
         if fault_point("raft.append",
                        detail=f"{self.node_id}->{peer}") == DROP:
             return   # injected replication loss: the retry tick re-sends
-        next_i = self._next_index.get(peer, self.state.last_index() + 1)
         prev = next_i - 1
-        entries = tuple(self.state.log[prev:])
+        entries = tuple(
+            self.state.log[prev - self.state.snapshot_index:])
         self._post(peer, AppendEntries(
             self.state.current_term, self.node_id, prev,
             self.state.term_at(prev), entries, self.state.commit_index))
+
+    def _send_snapshot(self, peer: str) -> None:
+        from ..utils.faults import DROP, fault_point
+        blob = self._snapshot_blob
+        if blob is None:
+            # defensive: a snapshot_index > 0 without a retained blob can
+            # only mean a storage load gave us an index but no data; the
+            # best we can do is resume appends from the base
+            self._next_index[peer] = self.state.snapshot_index + 1
+            return
+        if fault_point("raft.snapshot.install",
+                       detail=f"{self.node_id}->{peer}") == DROP:
+            return   # injected install loss: the heartbeat tick re-sends
+        self._installs_sent += 1
+        from ..observability import jlog
+        jlog(log, "raft.snapshot.install.sent", node=self.node_id,
+             peer=peer, last_index=self.state.snapshot_index,
+             bytes=len(blob))
+        self._post(peer, InstallSnapshot(
+            self.state.current_term, self.node_id,
+            self.state.snapshot_index, self.state.snapshot_term, blob))
 
     # -- client submission ---------------------------------------------------
     #: consensus_commit threads the notary's span context through submit()
@@ -441,6 +545,8 @@ class RaftNode:
             self._on_append(m)
         elif isinstance(m, AppendResponse):
             self._on_append_response(m)
+        elif isinstance(m, InstallSnapshot):
+            self._on_install_snapshot(m)
         elif isinstance(m, ClientRequest):
             self._handle_client_request(m)
         elif isinstance(m, ClientResponse):
@@ -476,27 +582,55 @@ class RaftNode:
         self.leader_id = m.leader
         self._election_started = None   # another node won this episode
         self._election_deadline = self._new_election_timeout()
-        # consistency check at prev_log_index (negative values never come
-        # from a correct leader and would index the log from the end)
-        if m.prev_log_index < 0 or m.prev_log_index > self.state.last_index() \
-                or self.state.term_at(m.prev_log_index) != m.prev_log_term:
+        if m.prev_log_index < 0:
+            # negative values never come from a correct leader and would
+            # index the log from the end
             self._post(m.leader, AppendResponse(self.state.current_term,
                                                 self.node_id, False, 0))
+            return
+        # compaction base: entries at or below our snapshot index are
+        # committed and applied here by definition — drop the overlap and
+        # re-anchor prev at the snapshot. A frame entirely below the base
+        # (stale retransmit, or a leader probing backwards) is acked at its
+        # own coverage so the leader's next_index walks forward again.
+        prev, prev_term, entries = m.prev_log_index, m.prev_log_term, m.entries
+        snap = self.state.snapshot_index
+        if prev < snap:
+            drop = min(len(entries), snap - prev)
+            if drop:
+                prev_term = entries[drop - 1].term
+                entries = entries[drop:]
+                prev += drop
+            if prev < snap:
+                self._post(m.leader, AppendResponse(
+                    self.state.current_term, self.node_id, True,
+                    m.prev_log_index + len(m.entries)))
+                return
+        # consistency check at prev (Raft §5.3). On failure the response
+        # carries our last_index as a fast-backup hint: the leader jumps
+        # next_index there instead of decrementing once per round trip —
+        # without it a rejoining follower far behind a compacted leader
+        # would never walk back to the snapshot boundary in useful time.
+        if prev > self.state.last_index() \
+                or self.state.term_at(prev) != prev_term:
+            self._post(m.leader, AppendResponse(
+                self.state.current_term, self.node_id, False,
+                self.state.last_index()))
             return
         # Raft §5.3: truncate only from the first term-conflicting entry —
         # a stale/duplicated append whose entries match the existing suffix
         # must not discard later entries already replicated past it
-        idx = m.prev_log_index + 1
+        idx = prev + 1
         keep = 0
-        for keep, entry in enumerate(m.entries):
+        for keep, entry in enumerate(entries):
             if idx + keep > self.state.last_index() or \
                     self.state.term_at(idx + keep) != entry.term:
                 break
         else:
-            keep = len(m.entries)
-        if keep < len(m.entries):
-            self.state.log = (self.state.log[:idx + keep - 1]
-                              + list(m.entries[keep:]))
+            keep = len(entries)
+        if keep < len(entries):
+            self.state.log = (self.state.log[:idx + keep - 1 - snap]
+                              + list(entries[keep:]))
             self._persist_suffix(idx + keep)
         if m.leader_commit > self.state.commit_index:
             # Raft: clamp to the last entry THIS append covered, not the
@@ -528,9 +662,62 @@ class RaftNode:
             self._next_index[m.follower] = match + 1
             self._maybe_commit()
         else:
-            self._next_index[m.follower] = max(
-                1, self._next_index.get(m.follower, 1) - 1)
+            # fast backup: the rejection carries the follower's last_index
+            # as a hint — jump straight below it (clamped so a forged huge
+            # hint cannot push next_index forward past the decrement)
+            nxt = self._next_index.get(m.follower, 1) - 1
+            hint = m.match_index
+            if isinstance(hint, int) and 0 <= hint < nxt:
+                nxt = hint + 1
+            self._next_index[m.follower] = max(1, nxt)
             self._send_append(m.follower)
+
+    def _on_install_snapshot(self, m: InstallSnapshot) -> None:
+        self._observe_term(m.term)
+        if m.term < self.state.current_term:
+            self._post(m.leader, AppendResponse(self.state.current_term,
+                                                self.node_id, False, 0))
+            return
+        self.role = FOLLOWER
+        self.leader_id = m.leader
+        self._election_started = None
+        self._election_deadline = self._new_election_timeout()
+        if m.last_index <= self.state.commit_index:
+            # already caught up past the snapshot (duplicate/stale install):
+            # ack so the leader resumes appends from last_index + 1
+            self._post(m.leader, AppendResponse(
+                self.state.current_term, self.node_id, True, m.last_index))
+            return
+        if self.restore_fn is None:
+            # a replica without the restore seam cannot accept a snapshot;
+            # stay silent — the leader keeps re-offering on heartbeats
+            log.warning("%s received InstallSnapshot but has no restore_fn",
+                        self.node_id)
+            return
+        self.restore_fn(m.data)
+        # discard the whole local log: everything ≤ last_index is covered
+        # by the snapshot, and anything beyond it is uncommitted here
+        # (commit_index < m.last_index) hence safe to drop (Raft §7)
+        self.state.log = []
+        self.state.snapshot_index = m.last_index
+        self.state.snapshot_term = m.last_term
+        self.state.commit_index = m.last_index
+        self.state.last_applied = m.last_index
+        self._snapshot_blob = m.data
+        self._snapshot_bytes = len(m.data)
+        self._installs_received += 1
+        if self.storage is not None:
+            self.storage.save_snapshot(m.last_index, m.last_term, m.data)
+            self.storage.truncate_from(m.last_index + 1)
+        from ..observability import get_tracer, jlog
+        jlog(log, "raft.snapshot.installed", node=self.node_id,
+             leader=m.leader, last_index=m.last_index, bytes=len(m.data))
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.record("raft.snapshot.install", node=self.node_id,
+                          last_index=m.last_index, bytes=len(m.data))
+        self._post(m.leader, AppendResponse(
+            self.state.current_term, self.node_id, True, m.last_index))
 
     def _maybe_commit(self) -> None:
         n_nodes = len(self.peers) + 1
@@ -547,7 +734,7 @@ class RaftNode:
     def _apply_committed(self) -> None:
         while self.state.last_applied < self.state.commit_index:
             self.state.last_applied += 1
-            entry = self.state.log[self.state.last_applied - 1]
+            entry = self.state.entry_at(self.state.last_applied)
             if entry.entry == NOOP:
                 continue
             clock = self._entry_clock.pop(
@@ -568,6 +755,54 @@ class RaftNode:
                     self._resolve(resp)
                 elif self.role == LEADER:
                     self._post(entry.client, resp)
+        self._maybe_snapshot()
+
+    def _maybe_snapshot(self) -> None:
+        """Compact when the applied span since the last snapshot reaches
+        the configured entry-count threshold (injectable for tests)."""
+        if self.snapshot_fn is None or not self.snapshot_entries:
+            return
+        if (self.state.last_applied - self.state.snapshot_index
+                < self.snapshot_entries):
+            return
+        self._take_snapshot()
+
+    def _take_snapshot(self) -> None:
+        """Serialize the state machine at last_applied, persist the
+        snapshot record, truncate the in-memory prefix. Persist failures
+        (including injected ``raft.snapshot.persist`` faults) abort the
+        round with nothing mutated in memory — the store stays loadable
+        (snapshot record written before the prefix delete, and load
+        filters entries the snapshot covers) and the next apply retries."""
+        from ..observability import get_tracer, jlog
+        snap_index = self.state.last_applied
+        snap_term = self.state.term_at(snap_index)
+        perf_t0, epoch_t0 = _time.perf_counter(), _time.time()
+        blob = self.snapshot_fn()
+        if self.storage is not None:
+            try:
+                self.storage.save_snapshot(snap_index, snap_term, blob)
+            except Exception as e:
+                jlog(log, "raft.snapshot.persist_failed",
+                     level=logging.WARNING, node=self.node_id,
+                     index=snap_index, error=str(e))
+                return
+        drop = snap_index - self.state.snapshot_index
+        self.state.log = self.state.log[drop:]
+        self.state.snapshot_index = snap_index
+        self.state.snapshot_term = snap_term
+        self._snapshot_blob = blob
+        self._snapshot_bytes = len(blob)
+        self._snapshots_taken += 1
+        duration = _time.perf_counter() - perf_t0
+        jlog(log, "raft.snapshot.taken", node=self.node_id,
+             index=snap_index, term=snap_term, bytes=len(blob),
+             dropped_entries=drop)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.record("raft.snapshot.persist", start_s=epoch_t0,
+                          duration_s=duration, node=self.node_id,
+                          index=snap_index, bytes=len(blob))
 
     def _on_client_response(self, m: ClientResponse) -> None:
         self._resolve(m)
@@ -634,7 +869,15 @@ class RaftNode:
                 "leader_id": self.leader_id,
                 "commit_index": self.state.commit_index,
                 "last_applied": self.state.last_applied,
-                "log_entries": self.state.last_index(),
+                # retained (post-compaction) log length; equals the last
+                # absolute index only while no snapshot has been taken
+                "log_entries": len(self.state.log),
+                "last_log_index": self.state.last_index(),
+                "snapshot_index": self.state.snapshot_index,
+                "snapshots_taken": self._snapshots_taken,
+                "installs_sent": self._installs_sent,
+                "installs_received": self._installs_received,
+                "snapshot_bytes": self._snapshot_bytes,
                 "elections_total": self._elections_total,
                 "elections": list(self._elections),
                 "leader_tenure_s": (now - self._leader_since[0]

@@ -158,22 +158,39 @@ class RaftUniquenessProvider(UniquenessProvider):
     def build(node_id: str, peers: list[str], messaging,
               state_machine: DistributedImmutableMap | None = None,
               seed: int | None = None, native: bool | None = None,
-              storage_path: str | None = None) -> "RaftUniquenessProvider":
+              storage_path: str | None = None,
+              snapshot_entries: int | None = None
+              ) -> "RaftUniquenessProvider":
         """``native``: None auto-selects the C++ protocol core when built
         (the kvstore engine-selection stance); True requires it; False forces
         the pure-Python replica. Both are wire-compatible.
 
-        ``storage_path``: persist the replica's Raft state (term/vote/log)
-        there so the cluster survives restarts — durable persistence is the
-        Python replica's feature, so it forces native off."""
+        ``storage_path``: persist the replica's Raft state (term/vote/log,
+        and the compaction snapshot) there so the cluster survives
+        restarts — durable persistence is the Python replica's feature, so
+        it forces native off.
+
+        ``snapshot_entries``: arm log compaction (ISSUE 20) — the replica
+        snapshots the DistributedImmutableMap every N applied entries and
+        truncates the log prefix; a lagging follower catches up via
+        InstallSnapshot. Compaction is a Python-replica feature, so like
+        storage it forces native off. The snapshot/restore seam is wired
+        regardless (it also serves InstallSnapshot receipt and
+        crash-restart restore even on replicas that never self-compact)."""
         sm = state_machine if state_machine is not None else DistributedImmutableMap()
-        if storage_path is not None:
+        if storage_path is not None or snapshot_entries is not None:
             if native:
                 raise RuntimeError(
-                    "durable raft storage requires the Python replica")
-            from .raft_store import RaftLogStore
+                    "durable raft storage and log compaction require the "
+                    "Python replica")
+            storage = None
+            if storage_path is not None:
+                from .raft_store import RaftLogStore
+                storage = RaftLogStore(storage_path)
             raft = RaftNode(node_id, peers, messaging, sm.apply, seed=seed,
-                            storage=RaftLogStore(storage_path))
+                            storage=storage, snapshot_fn=sm.snapshot,
+                            restore_fn=sm.restore,
+                            snapshot_entries=snapshot_entries)
         elif native or native is None:
             from .raftcore import NATIVE_RAFT_AVAILABLE, NativeRaftNode
             if NATIVE_RAFT_AVAILABLE:
@@ -183,9 +200,12 @@ class RaftUniquenessProvider(UniquenessProvider):
                 raise RuntimeError(
                     "native raft requested but libraftcore.so is not built")
             else:
-                raft = RaftNode(node_id, peers, messaging, sm.apply, seed=seed)
+                raft = RaftNode(node_id, peers, messaging, sm.apply,
+                                seed=seed, snapshot_fn=sm.snapshot,
+                                restore_fn=sm.restore)
         else:
-            raft = RaftNode(node_id, peers, messaging, sm.apply, seed=seed)
+            raft = RaftNode(node_id, peers, messaging, sm.apply, seed=seed,
+                            snapshot_fn=sm.snapshot, restore_fn=sm.restore)
         provider = RaftUniquenessProvider(raft)
         provider.state_machine = sm
         return provider

@@ -306,9 +306,12 @@ class NativeRaftNode:
     def stats(self) -> dict:
         """Observatory parity with RaftNode.stats(): everything the C core's
         getters expose. Fields the core cannot attribute (per-entry commit
-        decomposition, election episode timings, per-peer lag) are ABSENT —
-        never zero — so a mixed python/native fleet renders one coherent
-        observatory with honest gaps."""
+        decomposition, election episode timings, per-peer lag, and the
+        ISSUE 20 compaction family — snapshot_index / snapshots_taken /
+        installs_sent / installs_received / snapshot_bytes; the native core
+        keeps the whole log, so its ``log_entries`` IS the last absolute
+        index) are ABSENT — never zero — so a mixed python/native fleet
+        renders one coherent observatory with honest gaps."""
         import time as _t
         with self._lock:
             role = self.role

@@ -96,16 +96,31 @@ class CoordinatorLog:
     serialized log (fsync'd, like FileUniquenessProvider) so the record
     survives coordinator restarts; replaying the file reconstructs the
     in-doubt set.
+
+    GC (ISSUE 20): completed transactions contribute three dead lines
+    each, so a long-running coordinator's log grows without bound.
+    ``compact()`` rewrites ONLY the live (in-doubt) entries to a side
+    file, fsyncs it, and atomically renames it over the log — replaying
+    the compacted file reconstructs the identical in-doubt set
+    (``recover_in_doubt`` equivalence is the test invariant). With
+    ``compact_threshold_bytes`` set, ``complete()`` triggers compaction
+    automatically once the appended bytes cross the threshold — the
+    bounded-sawtooth behavior the soak observatory gates on.
     """
 
-    def __init__(self, path: str | None = None):
+    def __init__(self, path: str | None = None,
+                 compact_threshold_bytes: int | None = None):
         self.path = path
+        self.compact_threshold_bytes = compact_threshold_bytes
         self._lock = threading.Lock()
         self._entries: dict = {}     # tx_id -> {"status", "by_shard"}
         #: logical log bytes appended (including replayed history) — the
         #: CoordinatorLog.Bytes soak gauge. Counted even without a path
-        #: so an in-memory decision record still shows growth.
+        #: so an in-memory decision record still shows growth; compaction
+        #: resets it to the live-entry footprint (the sawtooth floor).
         self.bytes_appended = 0
+        self.compactions = 0
+        self.bytes_reclaimed = 0
         if path is not None:
             self._replay()
 
@@ -167,6 +182,66 @@ class CoordinatorLog:
         with self._lock:
             self._entries.pop(tx_id, None)
             self._append(("complete", tx_id, None))
+            if self.compact_threshold_bytes is not None \
+                    and self.bytes_appended >= self.compact_threshold_bytes:
+                self._compact_locked()
+
+    def compact(self) -> int:
+        """GC the decision log: rewrite only live (in-doubt) entries,
+        fsync, atomically rename over the old log. Returns the logical
+        bytes reclaimed. Safe to call at any time; a failure (including
+        an injected ``coordlog.compact`` fault) leaves the original log
+        untouched."""
+        with self._lock:
+            return self._compact_locked()
+
+    def _compact_locked(self) -> int:
+        # NB: self._lock is a plain (non-reentrant) Lock — this helper
+        # assumes the caller holds it.
+        import base64
+        from ..core.serialization import serialize
+        lines = []
+        for tx_id, entry in self._entries.items():
+            lines.append(base64.b64encode(serialize(
+                ("begin", tx_id,
+                 [(s, list(refs))
+                  for s, refs in entry["by_shard"].items()]))) + b"\n")
+            if entry["status"] != "prepare":
+                lines.append(base64.b64encode(serialize(
+                    ("decide", tx_id, entry["status"]))) + b"\n")
+        content = b"".join(lines)
+        reclaimed = self.bytes_appended - len(content)
+        if reclaimed <= 0:
+            return 0
+        try:
+            from ..utils.faults import DROP, fault_point
+            if self.path is not None:
+                import os
+                tmp = self.path + ".compact"
+                with open(tmp, "wb") as f:
+                    f.write(content)
+                    f.flush()
+                    os.fsync(f.fileno())
+                if fault_point("coordlog.compact") == DROP:
+                    return 0   # injected abort: original log untouched
+                os.replace(tmp, self.path)
+            elif fault_point("coordlog.compact") == DROP:
+                return 0
+        except Exception as e:
+            import logging
+            from ..observability import jlog
+            jlog(logging.getLogger(__name__), "coordlog.compact_failed",
+                 level=logging.WARNING, error=str(e))
+            return 0
+        self.bytes_appended = len(content)
+        self.compactions += 1
+        self.bytes_reclaimed += reclaimed
+        import logging
+        from ..observability import jlog
+        jlog(logging.getLogger(__name__), "coordlog.compact",
+             level=logging.INFO, live_entries=len(self._entries),
+             bytes_reclaimed=reclaimed, bytes_live=len(content))
+        return reclaimed
 
     def in_doubt(self) -> list:
         """Snapshot of unresolved entries: [(tx_id, {"status", "by_shard"})]."""
@@ -272,7 +347,10 @@ class ShardedUniquenessProvider(UniquenessProvider):
         return {"shards": shards, "touch_matrix": touch,
                 "skew_index": skew_index(requests),
                 "coordinator_log_bytes": getattr(self.log, "bytes_appended", 0),
-                "coordinator_in_doubt": len(self.log)}
+                "coordinator_in_doubt": len(self.log),
+                "coordinator_compactions": getattr(self.log, "compactions", 0),
+                "coordinator_bytes_reclaimed": getattr(
+                    self.log, "bytes_reclaimed", 0)}
 
     def _heat_collect(self) -> dict:
         """Metrics collector: Shard.* labeled families + coordinator-log
@@ -288,7 +366,10 @@ class ShardedUniquenessProvider(UniquenessProvider):
                "CoordinatorLog.Bytes": {"type": "gauge_fn",
                                         "value": stats["coordinator_log_bytes"]},
                "CoordinatorLog.InDoubt": {"type": "gauge_fn",
-                                          "value": stats["coordinator_in_doubt"]}}
+                                          "value": stats["coordinator_in_doubt"]},
+               "CoordinatorLog.Compactions": {
+                   "type": "gauge_fn",
+                   "value": stats["coordinator_compactions"]}}
         for entry in stats["shards"]:
             labels = {"shard": entry["shard"]}
             for field, family in (("requests", "Shard.Requests"),

@@ -11,9 +11,12 @@ read side: it pools those per-node surfaces into one per-group report
 ``ledger_raft_*`` bench artifact fields (benchguard-locked, with the
 attribution-sum validity probe), installs the labeled ``Raft.*`` metric
 families on a registry, feeds the retained time-series plane
-(timeseries.py), and watches the two known unbounded-growth hazards
+(timeseries.py), and watches the two known growth hazards
 (``Raft.LogEntries``, ``CoordinatorLog.Bytes``) for doubling within a
-run (ROADMAP item 5: logs grow unboundedly until compaction lands).
+run. With compaction landed (ISSUE 20) those gauges are expected to
+sawtooth: the watchdog resets its doubling baseline after each observed
+shrink (``consensus.growth.compacted``) so a legitimate post-compaction
+climb is measured from the new floor instead of warning spuriously.
 
 Everything here is defensive: a node whose ``stats()`` is missing or
 malformed contributes nothing rather than an exception — mixed
@@ -146,6 +149,27 @@ def raft_report(groups: dict, sharded=None) -> dict:
             "elections_total": int(sum(
                 v for v in (_num(s.get("elections_total"))
                             for s in node_stats) if v is not None)),
+            # compaction surfaces (ISSUE 20): typed-default ints — a
+            # native-only group reports zeros here (its per-NODE stats
+            # stay honestly absent; the group rollup is an artifact
+            # surface, so it keeps the always-present discipline)
+            "snapshot_index": int(max(
+                [v for v in (_num(s.get("snapshot_index"))
+                             for s in node_stats) if v is not None],
+                default=0)),
+            "snapshots_taken": int(sum(
+                v for v in (_num(s.get("snapshots_taken"))
+                            for s in node_stats) if v is not None)),
+            "installs_sent": int(sum(
+                v for v in (_num(s.get("installs_sent"))
+                            for s in node_stats) if v is not None)),
+            "installs_received": int(sum(
+                v for v in (_num(s.get("installs_received"))
+                            for s in node_stats) if v is not None)),
+            "snapshot_bytes": int(max(
+                [v for v in (_num(s.get("snapshot_bytes"))
+                             for s in node_stats) if v is not None],
+                default=0)),
         }
         attribution = pooled_percentiles(pool_attribution(nodes))
         if attribution:
@@ -196,6 +220,25 @@ def install_raft_collector(metrics, groups_fn) -> None:
             emit("Raft.Elections", label,
                  sum(v for v in (_num(s.get("elections_total"))
                                  for s in node_stats) if v is not None))
+            # compaction family (ISSUE 20): absent-not-zero — emitted only
+            # when at least one replica actually reports the field (the
+            # native core does not)
+            snap_idx = [v for v in (_num(s.get("snapshot_index"))
+                                    for s in node_stats) if v is not None]
+            if snap_idx:
+                emit("Raft.SnapshotIndex", label, max(snap_idx))
+            snaps = [v for v in (_num(s.get("snapshots_taken"))
+                                 for s in node_stats) if v is not None]
+            if snaps:
+                emit("Raft.SnapshotsTaken", label, sum(snaps))
+            installs = [v for v in (_num(s.get("installs_sent"))
+                                    for s in node_stats) if v is not None]
+            if installs:
+                emit("Raft.InstallsSent", label, sum(installs))
+            snap_bytes = [v for v in (_num(s.get("snapshot_bytes"))
+                                      for s in node_stats) if v is not None]
+            if snap_bytes:
+                emit("Raft.SnapshotBytes", label, max(snap_bytes))
             if leader is not None:
                 emit("Raft.CommitIndex", label, leader.get("commit_index"))
                 emit("Raft.Term", label, leader.get("term"))
@@ -235,15 +278,36 @@ class GrowthWatch:
     def __init__(self, logger=None, floor: float = 1024.0):
         self.floor = floor
         self.warnings = 0        # doubling warnings fired this run
+        self.compactions = 0     # baseline resets after observed shrinks
         self._log = logger if logger is not None else log
         self._armed: dict = {}   # name -> level the next warning fires at 2×
 
     def observe(self, name: str, value) -> bool:
-        """Feed one sample; returns True when a doubling warning fired."""
+        """Feed one sample; returns True when a doubling warning fired.
+
+        A sample well BELOW the armed level means the gauge was compacted
+        (raft log truncation / CoordinatorLog GC): the doubling baseline
+        resets to the post-compaction floor so the next legitimate 2× is
+        measured from there — without this, a sawtoothing log would warn
+        on every recovery climb (the ISSUE 20 false-alarm fix). The 0.9
+        factor is hysteresis: leader churn can wobble a max-over-replicas
+        gauge a few percent without any compaction happening."""
         v = _num(value)
-        if v is None or v < self.floor:
+        if v is None:
             return False
         level = self._armed.get(name)
+        if level is not None and v < 0.9 * level:
+            self.compactions += 1
+            if v < self.floor:
+                self._armed.pop(name, None)
+            else:
+                self._armed[name] = v
+            jlog(self._log, "consensus.growth.compacted",
+                 level=logging.INFO, gauge=name, value=v, previous=level,
+                 reclaimed=round(level - v, 2))
+            return False
+        if v < self.floor:
+            return False
         if level is None:
             self._armed[name] = v
             return False
@@ -343,4 +407,20 @@ def ledger_raft_fields(groups: dict, round_samples=None) -> dict:
         v for g in (groups or {}).values()
         for v in (_num((_node_stats(n) or {}).get("elections_total"))
                   for n in g) if v is not None))
+    # compaction rollup (ISSUE 20): typed-default ints over every replica
+    # of every group — zeros on a native fleet (absent per-node stats),
+    # real counts on compacting python replicas
+    all_stats = [s for g in (groups or {}).values()
+                 for s in (_node_stats(n) for n in g) if s is not None]
+
+    def _agg(field, fn):
+        vals = [v for v in (_num(s.get(field)) for s in all_stats)
+                if v is not None]
+        return int(fn(vals)) if vals else 0
+
+    out["ledger_raft_snapshot_index"] = _agg("snapshot_index", max)
+    out["ledger_raft_snapshots_taken"] = _agg("snapshots_taken", sum)
+    out["ledger_raft_installs_sent"] = _agg("installs_sent", sum)
+    out["ledger_raft_installs_received"] = _agg("installs_received", sum)
+    out["ledger_raft_snapshot_bytes"] = _agg("snapshot_bytes", max)
     return out

@@ -89,6 +89,14 @@ class SoakConfig:
     chaos_period_s: float = 75.0
     chaos_window_s: float = 2.5
     chaos_append_drop_p: float = 0.15
+    #: bounded-state consensus (ISSUE 20): arm raft snapshot compaction
+    #: and CoordinatorLog GC so the endurance run's log structures
+    #: sawtooth instead of growing monotonically. With these set the
+    #: RaftLog/CoordinatorLog probes are declared ``bounded`` with the
+    #: 2×-threshold sawtooth cap as their bound — sustained growth past
+    #: it is a LEAK verdict, which the soak gate fails on.
+    raft_snapshot_entries: int | None = 64
+    coordlog_compact_bytes: int | None = 65536
     #: phase (segment) length for the per-minute artifact series
     phase_s: float = 60.0
     #: resource-probe sampling cadence into the retained plane
@@ -116,7 +124,9 @@ class SoakConfig:
             settle_fraction=0.0, seed=seed,
             chaos_period_s=6.0, chaos_window_s=0.8,
             phase_s=5.0, sample_interval_s=0.4, invariant_check_s=4.0,
-            cpu_sample_interval_s=0.01, mode="soak-smoke")
+            cpu_sample_interval_s=0.01,
+            raft_snapshot_entries=8, coordlog_compact_bytes=4096,
+            mode="soak-smoke")
 
 
 class _RecurringChaos:
@@ -324,18 +334,35 @@ class SoakObserver:
         plane. Probes are defensive closures over live objects; a probe
         whose surface is absent simply never registers."""
         reg = self.resources
+        cfg = ctx.get("cfg")
+        # bounded-state consensus (ISSUE 20): with compaction armed the
+        # raft log's contract flips from "grows until GC" to a bounded
+        # sawtooth — declare it so, with 2× the snapshot threshold as
+        # the cap, and the leak gate enforces the invariant the whole
+        # soak long. Without compaction the honest declaration stays
+        # "grows" (the pre-r06 unbounded-log hazard, named in ROADMAP).
+        snap_thr = getattr(cfg, "raft_snapshot_entries", None)
         for label, nodes in (ctx.get("raft_groups") or {}).items():
             def probe(nodes=nodes):
                 return max((len(getattr(rn.state, "log", ()))
                             for rn in nodes), default=0)
-            reg.register(f"RaftLog.{label}", probe, kind="grows")
+            if snap_thr:
+                reg.register(f"RaftLog.{label}", probe, kind="bounded",
+                             bound=2.0 * snap_thr)
+            else:
+                reg.register(f"RaftLog.{label}", probe, kind="grows")
         sharded = ctx.get("sharded")
         if sharded is not None:
             log = getattr(sharded, "log", None)
             if log is not None:
-                reg.register("CoordinatorLog.Bytes",
-                             lambda log=log: getattr(log, "bytes_appended", 0),
-                             kind="grows")
+                probe = lambda log=log: getattr(log, "bytes_appended", 0)
+                gc_thr = getattr(cfg, "coordlog_compact_bytes", None)
+                if gc_thr:
+                    reg.register("CoordinatorLog.Bytes", probe,
+                                 kind="bounded", bound=2.0 * gc_thr)
+                else:
+                    reg.register("CoordinatorLog.Bytes", probe,
+                                 kind="grows")
         from .tracing import get_tracer
         ring = getattr(get_tracer(), "ring", None)
         if ring is not None:
@@ -540,6 +567,8 @@ def run_soak(cfg: SoakConfig | None = None) -> dict:
         seed=cfg.seed, chaos=False,       # the observer drives recurrence
         settle_fraction=cfg.settle_fraction,
         shards=cfg.shards, cross_shard_pct=cfg.cross_shard_pct,
+        raft_snapshot_entries=cfg.raft_snapshot_entries,
+        coordlog_compact_bytes=cfg.coordlog_compact_bytes,
         provider_timeout_s=cfg.provider_timeout_s,
         max_duration_s=cfg.minutes * 60.0 + 120.0,
         mode=cfg.mode, observer=SoakObserver(cfg))

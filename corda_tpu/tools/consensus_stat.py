@@ -137,7 +137,7 @@ def render(raft: dict, timeseries: dict | None = None,
     lines = [
         f"consensus groups: {len(groups)}",
         f"{'GROUP':<8}{'LEADER':<10}{'TERM':>6}{'TENURE(s)':>11}"
-        f"{'ELECTIONS':>11}{'LOG':>8}{'LAG':>5}"
+        f"{'ELECTIONS':>11}{'LOG':>8}{'SNAP':>7}{'INST':>6}{'LAG':>5}"
         f"{'  APPEND(p50/99ms)':>19}{'FSYNC':>12}{'REPL':>12}{'APPLY':>12}",
     ]
     for label in sorted(groups, key=str):
@@ -162,6 +162,10 @@ def render(raft: dict, timeseries: dict | None = None,
                and not isinstance(tenure, bool) else f"{'-':>11}")
             + f"{_cell(g.get('elections_total'), 0):>11}"
             f"{_cell(g.get('log_entries'), 0):>8}"
+            # compaction columns (ISSUE 20): "-" on pre-r06 payloads that
+            # predate the snapshot fields, real values after
+            f"{_cell(g.get('snapshot_index'), '-'):>7}"
+            f"{_cell(g.get('installs_received'), '-'):>6}"
             f"{_cell(lag_max, '-'):>5}"
             f"{_ms(attrib, 'append_wait'):>19}"
             f"{_ms(attrib, 'fsync'):>12}"
@@ -178,7 +182,8 @@ def render(raft: dict, timeseries: dict | None = None,
                and not isinstance(skew, bool) else "-")
             + f"  coordinator_log_bytes="
               f"{_cell(shards.get('coordinator_log_bytes'), '-')}"
-            + f"  in_doubt={_cell(shards.get('coordinator_in_doubt'), 0)}")
+            + f"  in_doubt={_cell(shards.get('coordinator_in_doubt'), 0)}"
+            + f"  gc={_cell(shards.get('coordinator_compactions'), '-')}")
         rows = shards.get("shards")
         if isinstance(rows, (list, tuple)):
             cells = []

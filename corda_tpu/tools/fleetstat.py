@@ -188,7 +188,10 @@ def render(fleet: dict, metrics: dict, critpath: dict | None = None,
                 f" elections={_cell(g.get('elections_total'), 0)}"
                 f" fsync_p99={fsync_txt}"
                 f" lag={_cell(lag_max, '-')}"
-                f" log={_cell(g.get('log_entries'), 0)}")
+                f" log={_cell(g.get('log_entries'), 0)}"
+                # "-" on pre-r06 payloads without the compaction fields
+                f" snap={_cell(g.get('snapshot_index'), '-')}"
+                f" inst={_cell(g.get('installs_received'), '-')}")
         if parts:
             lines.append("consensus: " + "  ".join(parts))
     per_class = critpath.get("per_class") if isinstance(critpath, dict) \

@@ -42,6 +42,13 @@ def _parser() -> argparse.ArgumentParser:
                          "exchange-like party, then a deliberate "
                          "double-spend replay burst (combine with --full "
                          "for the measured shape)")
+    ap.add_argument("--byzantine", action="store_true",
+                    help="hostile-client preset: replayed, mis-signed and "
+                         "malformed transactions injected mid-load on a "
+                         "sharded topology; exits 1 unless every one was "
+                         "rejected with throughput held and zero "
+                         "reservation leaks (combine with --full / "
+                         "--chaos for the measured shape)")
     ap.add_argument("--shards", type=int, default=None,
                     help="sharded-notary preset: partition the uniqueness "
                          "domain over N raft groups with a cross-shard "
@@ -73,7 +80,14 @@ def build_config(argv=None):
 
     args = _parser().parse_args(argv)
 
-    if args.shards is not None and args.shards > 1:
+    if args.byzantine:
+        cfg = LedgerScenarioConfig.byzantine(full=args.full)
+        cfg.chaos = args.chaos
+        if args.shards is not None and args.shards > 1:
+            cfg.shards = args.shards
+        if args.cross_shard_pct is not None:
+            cfg.cross_shard_pct = args.cross_shard_pct
+    elif args.shards is not None and args.shards > 1:
         cfg = LedgerScenarioConfig.sharded(
             shards=args.shards,
             cross_shard_pct=(args.cross_shard_pct
@@ -155,6 +169,14 @@ def main(argv=None) -> int:
         # the hot vault still committed real throughput
         ok = ok and report["double_spend_rejection_rate"] == 1.0 \
             and report["committed_tx_per_sec"] > 0
+    if report.get("byzantine"):
+        # the hostile-client gate (ISSUE 20): every injected replay /
+        # mis-sign / malformed submission rejected, honest throughput
+        # held, and no byzantine attempt left a reservation behind
+        ok = ok and report["byzantine_attempted"] > 0 \
+            and report["byzantine_rejection_rate"] == 1.0 \
+            and report["committed_tx_per_sec"] > 0 \
+            and report.get("ledger_shard_reserved_leftover", 0) == 0
     if report.get("ledger_shard_count", 1) > 1:
         # the sharded gate: exactly-once held across shards (base ok
         # already covers it), the cross-shard 2PC path actually committed

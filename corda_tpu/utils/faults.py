@@ -40,6 +40,11 @@ point                  seam
 ``oop.reply``          verifier worker → service reply send
 ``kvstore.flush``      KvStore, before the engine append (durability seam)
 ``smm.checkpoint_remove`` SMM ``_finalize``, before ``remove_checkpoint``
+``raft.snapshot.persist`` RaftLogStore.save_snapshot, between the snapshot
+                       write and the covered-prefix delete (torn-persist seam)
+``raft.snapshot.install`` raft leader, before posting an InstallSnapshot
+``coordlog.compact``   CoordinatorLog GC, after the side-file fsync and
+                       before the atomic rename over the live log
 ====================== ======================================================
 
 ``detail`` carries the call-site specifics (``"alice->bob"`` on sends,

